@@ -1,0 +1,35 @@
+"""protocol_tpu — a TPU-native EigenTrust reputation framework.
+
+A ground-up rebuild of the capabilities of the `brech1/protocol` reference
+("ZK Eigen Trust"): peers sign EdDSA attestations of trust in their
+neighbours, a node ingests attestations from an on-chain AttestationStation
+registry, computes global trust scores by EigenTrust power iteration each
+epoch, and serves a verifiable proof of the result.
+
+Where the reference runs a fixed 5-peer convergence loop on CPU
+(circuit/src/circuit.rs:425-470), this framework executes the convergence
+loop on TPU through JAX/XLA: dense `jnp` kernels for small sets, sparse
+(BCOO / COO segment-sum) kernels for real graphs, and `shard_map`-sharded
+SpMV with `lax.psum` collectives over a `jax.sharding.Mesh` for 1M+ peer
+graphs — behind a pluggable `TrustBackend`.
+
+Subpackages
+-----------
+- ``crypto``   — Bn254 Fr field, Poseidon/Rescue-Prime, BabyJubJub EdDSA,
+  BLAKE-512 KDF (reference: circuit/src/{poseidon,eddsa,edwards,params}).
+- ``trust``    — exact-field native trust kernels and the set-managed
+  EigenTrust semantics (reference: circuit/src/circuit.rs::native,
+  circuit/src/native.rs::EigenTrustSet).
+- ``ops``      — jit'd JAX kernels: dense/sparse power iteration, fixed
+  point utilities.
+- ``parallel`` — device mesh helpers and sharded SpMV collectives.
+- ``models``   — the flagship EigenTrust "model" and graph generators.
+- ``zk``       — constraint system, gadget library, EigenTrust circuit and
+  a MockProver-equivalent checker (reference: circuit/src/{lib,gadgets}).
+- ``node``     — the protocol node: manager, attestation codec, epoch
+  loop, HTTP API (reference: server/src).
+- ``client``   — CLI wallet: attest / verify / deploy (reference:
+  client/src).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,40 @@
+"""Cryptographic primitives: Bn254 Fr, Poseidon, BabyJubJub EdDSA.
+
+Every primitive has a pure-Python exact implementation here, mirroring the
+reference's ``native/`` modules; the C++ fast path (``protocol_tpu.crypto
+.native``) accelerates batch attestation verification and must stay
+bit-compatible (the analog of the reference's native↔circuit duality).
+"""
+
+from __future__ import annotations
+
+from . import babyjubjub, blake512, eddsa, field, poseidon  # noqa: F401
+from .poseidon import PoseidonSponge, permute
+
+
+def calculate_message_hash(
+    pks: list[eddsa.PublicKey], scores: list[list[int]]
+) -> tuple[int, list[int]]:
+    """Protocol message hash (circuit/src/lib.rs:225-256).
+
+    ``pks_hash = sponge(xs ‖ ys)``; for each score row,
+    ``Poseidon(pks_hash, sponge(row), 0, 0, 0)``.  Returns
+    ``(pks_hash, per-row message hashes)``.
+    """
+    n = len(pks)
+    for row in scores:
+        assert len(row) == n
+
+    pk_sponge = PoseidonSponge()
+    pk_sponge.update([pk.point.x for pk in pks])
+    pk_sponge.update([pk.point.y for pk in pks])
+    pks_hash = pk_sponge.squeeze()
+
+    messages = []
+    for row in scores:
+        score_sponge = PoseidonSponge()
+        score_sponge.update(row)
+        scores_hash = score_sponge.squeeze()
+        messages.append(permute([pks_hash, scores_hash, 0, 0, 0])[0])
+
+    return pks_hash, messages

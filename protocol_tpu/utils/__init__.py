@@ -1,0 +1,8 @@
+"""Shared utilities: byte codecs, base58, JSON data IO, logging."""
+
+from .codec import (  # noqa: F401
+    b58decode,
+    b58encode,
+    to_short,
+    to_wide,
+)

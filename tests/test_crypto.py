@@ -1,0 +1,277 @@
+"""Crypto layer tests: golden vectors baked from the reference's inline
+test modules (SURVEY.md §4 tier 1)."""
+
+import pytest
+
+from protocol_tpu.crypto import babyjubjub as bjj
+from protocol_tpu.crypto import calculate_message_hash, field
+from protocol_tpu.crypto.blake512 import blake512
+from protocol_tpu.crypto.eddsa import PublicKey, SecretKey, Signature, sign, verify
+from protocol_tpu.crypto.merkle import MerkleTree, Path
+from protocol_tpu.crypto.poseidon import (
+    POSEIDON_10,
+    PoseidonSponge,
+    permute,
+    rescue_prime_permute,
+)
+from protocol_tpu.utils.codec import b58decode, b58encode, to_short
+
+
+class TestField:
+    def test_roundtrip_bytes(self):
+        v = 0x1234567890ABCDEF << 128
+        assert field.from_le_bytes(field.to_le_bytes(v)) == v
+
+    def test_non_canonical_rejected(self):
+        bad = (field.MODULUS).to_bytes(32, "little")
+        with pytest.raises(ValueError):
+            field.from_le_bytes(bad)
+
+    def test_wide_reduction(self):
+        wide = b"\xff" * 64
+        assert field.from_wide_bytes(wide) == int.from_bytes(wide, "little") % field.MODULUS
+
+    def test_inv(self):
+        a = 123456789
+        assert field.mul(a, field.inv(a)) == 1
+        with pytest.raises(ZeroDivisionError):
+            field.inv(0)
+
+    def test_bits_lsb_first(self):
+        assert field.to_bits(b"\x05")[:4] == [True, False, True, False]
+
+
+class TestPoseidon:
+    def test_permute_5x5_golden(self):
+        # circuit/src/poseidon/native/mod.rs:108-134
+        out = permute([0, 1, 2, 3, 4])
+        expected = [
+            0x299C867DB6C1FDD79DCEFA40E4510B9837E60EBB1CE0663DBAA525DF65250465,
+            0x1148AAEF609AA338B27DAFD89BB98862D8BB2B429ACEAC47D86206154FFE053D,
+            0x24FEBB87FED7462E23F6665FF9A0111F4044C38EE1672C1AC6B0637D34F24907,
+            0x0EB08F6D809668A981C186BEAF6110060707059576406B248E5D9CF6E78B3D3E,
+            0x07748BC6877C9B82C8B98666EE9D0626EC7F5BE4205F79EE8528EF1C4A376FC7,
+        ]
+        assert out == expected
+
+    def test_permute_10x5_shape(self):
+        out = permute(list(range(10)), POSEIDON_10)
+        assert len(out) == 10 and all(0 <= x < field.MODULUS for x in out)
+
+    def test_rescue_prime_golden(self):
+        # circuit/src/rescue_prime/native/mod.rs:68-96 (matter-labs vectors)
+        out = rescue_prime_permute([0, 1, 2, 3, 4])
+        expected = [
+            0x1A06EA09AF4D8D61F991846F001DED4056FEAFCEF55F1E9C4FD18100B8C7654F,
+            0x2F66D057B2BD9692F51E072013B8F320C5E6D7081070FFE7CA357E18E5FAECF4,
+            0x177ABF3B6A2E903ADF4C71F18F744B55B39C487A9A4FD1A1D4AEE381B99F357B,
+            0x1271BFA104C298EFACCC1680BE1B6E36CBF2C87EA789F2F79F7742BC16992235,
+            0x040F785ABFAD4DA68331F9C884343FA6EECB07060EBCD96117862ACEBAE5C3AC,
+        ]
+        assert out == expected
+
+    def test_sponge_chunks(self):
+        # Sponge over 10 elements = two chunk-permutes; must differ from a
+        # single-block hash and be deterministic.
+        s = PoseidonSponge()
+        s.update(list(range(10)))
+        h1 = s.squeeze()
+        s2 = PoseidonSponge()
+        s2.update(list(range(5)))
+        s2.update(list(range(5, 10)))
+        assert h1 == s2.squeeze()
+
+    def test_sponge_empty_asserts(self):
+        with pytest.raises(AssertionError):
+            PoseidonSponge().squeeze()
+
+
+class TestBlake512:
+    def test_one_zero_byte(self):
+        # BLAKE SHA-3 submission test vector (single 0x00 byte).
+        assert blake512(b"\x00").hex() == (
+            "97961587f6d970faba6d2478045de6d1fabd09b61ae50932054d52bc29d31be4"
+            "ff9102b9f69e2bbdb83be13d4b9c06091e5fa0b48bd081b634058be0ec49beb3"
+        )
+
+    def test_144_zero_bytes(self):
+        # Two-block vector from the submission (exercises the counter).
+        assert blake512(bytes(144)).hex() == (
+            "313717d608e9cf758dcb1eb0f0c3cf9fc150b2d500fb33f51c52afc99d358a2f"
+            "1374b8a38bba7974e7f6ef79cab16f22ce1e649d6e01ad9589c213045d545dde"
+        )
+
+    def test_length_111_boundary(self):
+        # 111 bytes mod 128: both padding bits share one byte; just check
+        # it digests without error and differs from neighbours.
+        assert blake512(bytes(111)) != blake512(bytes(112))
+
+
+class TestBabyJubJub:
+    # circuit/src/edwards/native.rs:95-247 vectors.
+    PX = 17777552123799933955779906779655732241715742912184938656739573121738514868268
+    PY = 2626589144620713026669568689430873010625803728049924121243784502389097019475
+
+    def test_add_same_point(self):
+        p = bjj.Point(self.PX, self.PY).projective()
+        r = p.add(p).affine()
+        assert r.x == 6890855772600357754907169075114257697580319025794532037257385534741338397365
+        assert r.y == 4338620300185947561074059802482547481416142213883829469920100239455078257889
+
+    def test_add_different_points(self):
+        p = bjj.Point(self.PX, self.PY).projective()
+        q = bjj.Point(
+            16540640123574156134436876038791482806971768689494387082833631921987005038935,
+            20819045374670962167435360035096875258406992893633759881276124905556507972311,
+        ).projective()
+        r = p.add(q).affine()
+        assert r.x == 7916061937171219682591368294088513039687205273691143098332585753343424131937
+        assert r.y == 14035240266687799601661095864649209771790948434046947201833777492504781204499
+
+    def test_mul_scalar(self):
+        p = bjj.Point(self.PX, self.PY)
+        r3 = p.mul_scalar(3).affine()
+        via_add = p.projective().add(p.projective()).add(p.projective()).affine()
+        assert r3 == via_add
+        assert r3.x == 19372461775513343691590086534037741906533799473648040012278229434133483800898
+        assert r3.y == 9458658722007214007257525444427903161243386465067105737478306991484593958249
+        n = 14035240266687799601661095864649209771790948434046947201833777492504781204499
+        r = p.mul_scalar(n).affine()
+        assert r.x == 17070357974431721403481313912716834497662307308519659060910483826664480189605
+        assert r.y == 4014745322800118607127020275658861516666525056516280575712425373174125159339
+
+    def test_generators_on_curve(self):
+        assert bjj.is_on_curve(bjj.B8)
+        assert bjj.is_on_curve(bjj.G)
+        assert bjj.B8.mul_scalar(bjj.SUBORDER).affine() == bjj.Point(0, 1)
+
+
+# The reference's hard-coded bootstrap identities
+# (server/src/manager/mod.rs:40-69).
+FIXED_SET = [
+    ("2L9bbXNEayuRMMbrWFynPtgkrXH1iBdfryRH9Soa8M67", "9rBeBVtbN2MkHDTpeAouqkMWNFJC6Bxb6bXH9jUueWaF"),
+    ("ARVqgNQtnV4JTKqgajGEpuapYEnWz93S5vwRDoRYWNh8", "2u1LC2JmKwkzUccS9hd5yS2DUUGTuYQ8MA7y28A9SgQY"),
+    ("phhPpTLWJbC4RM39Ww3e6wWvZnVkk86iNAXyA1tRAHJ", "93aMkAqd7AY4c3m6ij6RuBzw3F9QYhQsAMnkKF2Ck2R8"),
+    ("Bp3FqLd6Man9h7xujkbYDdhyF42F2dX871SJHvo3xsnU", "AUUqgGTvqzPetRMQdTrQ1xHnwz2BHDxPTi85wL4WYQaK"),
+    ("AKo18M6YSE1dQQuXt4HfWNrXA6dKXBVkWVghEi6827u1", "ArT8Kk13Heai2UPbMbrqs3RuVm4XXFN2pVHttUnKpDoV"),
+]
+PUBLIC_KEY_HASHES = [
+    "92tZdMN2SjXbT9byaHHt7hDDNXUphjwRt5UB3LDbgSmR",
+    "8uFaYMkkACmnUBRZyA9JbWVjP1KN1BA53wcfKHhGE3kg",
+    "DqVjJk7pBjnLXGVsCdD8SVQZLF3SZyypCB6SBJobwUMc",
+    "tbXeMMQDSs3XuKUJuzJyU2jTzr66iWtHaMb2eKiqUFM",
+    "Gz4dAnn3ex5Pq2vZQyJ94EqDdxpFaY74GJDFuuALvD6b",
+]
+
+
+class TestEddsa:
+    def test_fixed_set_public_key_hashes(self):
+        """End-to-end parity: the bs58 pk-hashes of the reference's
+        FIXED_SET must reproduce its PUBLIC_KEYS table exactly."""
+        for (sk0, sk1), expected in zip(FIXED_SET, PUBLIC_KEY_HASHES):
+            pk = SecretKey.from_bs58(sk0, sk1).public()
+            assert b58encode(field.to_le_bytes(pk.hash())) == expected
+
+    def test_sign_and_verify(self):
+        sk = SecretKey.random()
+        pk = sk.public()
+        m = 123456789012345678901234567890
+        sig = sign(sk, pk, m)
+        assert verify(sig, pk, m)
+
+    def test_invalid_big_r(self):
+        sk = SecretKey.random()
+        pk = sk.public()
+        m = 123456789012345678901234567890
+        sig = sign(sk, pk, m)
+        different_r = permute([0, 1, 1, 0, 0])[0]
+        bad = Signature(bjj.B8.mul_scalar(different_r).affine(), sig.s)
+        assert not verify(bad, pk, m)
+
+    def test_invalid_s(self):
+        sk = SecretKey.random()
+        pk = sk.public()
+        m = 123456789012345678901234567890
+        sig = sign(sk, pk, m)
+        assert not verify(Signature(sig.big_r, field.add(sig.s, 1)), pk, m)
+
+    def test_invalid_pk(self):
+        sk1, sk2 = SecretKey.random(), SecretKey.random()
+        m = 123456789012345678901234567890
+        sig = sign(sk1, sk1.public(), m)
+        assert not verify(sig, sk2.public(), m)
+
+    def test_invalid_message(self):
+        sk = SecretKey.random()
+        pk = sk.public()
+        sig = sign(sk, pk, 123456789012345678901234567890)
+        assert not verify(sig, pk, 123456789012345678901234567890123123)
+
+    def test_oversized_s_rejected(self):
+        sk = SecretKey.random()
+        pk = sk.public()
+        m = 42
+        sig = sign(sk, pk, m)
+        assert not verify(Signature(sig.big_r, sig.s + bjj.SUBORDER + 1), pk, m)
+
+    def test_secret_key_roundtrip(self):
+        sk = SecretKey.random()
+        assert SecretKey.from_raw(sk.to_raw()) == sk
+        pk = sk.public()
+        assert PublicKey.from_raw(pk.to_raw()) == pk
+
+
+class TestMessageHash:
+    def test_shape_and_determinism(self):
+        pks = [SecretKey.random().public() for _ in range(5)]
+        scores = [[100, 200, 300, 400, 0] for _ in range(2)]
+        pks_hash, messages = calculate_message_hash(pks, scores)
+        assert len(messages) == 2
+        assert messages[0] == messages[1]
+        pks_hash2, messages2 = calculate_message_hash(pks, [scores[0]])
+        assert pks_hash2 == pks_hash and messages2[0] == messages[0]
+
+    def test_differs_on_scores(self):
+        pks = [SecretKey.random().public() for _ in range(3)]
+        _, m1 = calculate_message_hash(pks, [[1, 2, 3]])
+        _, m2 = calculate_message_hash(pks, [[1, 2, 4]])
+        assert m1[0] != m2[0]
+
+
+class TestBase58:
+    def test_roundtrip(self):
+        for data in [b"", b"\x00\x00abc", bytes(range(32))]:
+            assert b58decode(b58encode(data)) == data
+
+    def test_known_value(self):
+        # Classic bitcoin-alphabet vector.
+        assert b58encode(b"hello world") == "StV1DL6CwTryKyV"
+        assert b58decode("StV1DL6CwTryKyV") == b"hello world"
+        assert b58encode(b"\x00\x00a") == "112g"
+        # Reference secrets decode to exactly 32 canonical bytes.
+        assert len(b58decode("2L9bbXNEayuRMMbrWFynPtgkrXH1iBdfryRH9Soa8M67")) == 32
+
+    def test_invalid_character(self):
+        with pytest.raises(ValueError):
+            b58decode("0OIl")
+
+
+class TestMerkle:
+    def test_build_and_path(self):
+        # merkle_tree/native.rs:115-140
+        import random
+
+        rng = random.Random(7)
+        leaves = [rng.randrange(field.MODULUS) for _ in range(9)]
+        value = leaves[4]
+        tree = MerkleTree.build(leaves, 4)
+        path = Path.find(tree, value)
+        assert path.verify()
+        assert path.pairs[tree.height][0] == tree.root
+
+    def test_tampered_path_fails(self):
+        leaves = [1, 2, 3, 4]
+        tree = MerkleTree.build(leaves, 2)
+        path = Path.find(tree, 3)
+        path.pairs[0] = (path.pairs[0][0], path.pairs[0][1] + 1)
+        assert not path.verify()
