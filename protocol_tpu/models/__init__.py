@@ -1,0 +1,4 @@
+"""Model layer: the flagship EigenTrust model and graph generators."""
+
+from .eigentrust import EigenTrustModel  # noqa: F401
+from .graphs import erdos_renyi, scale_free, sybil_stress  # noqa: F401

@@ -1,0 +1,38 @@
+"""The flagship model: EigenTrust global-trust convergence.
+
+Bundles a TrustGraph with convergence hyper-parameters (damping α,
+tolerance, iteration budget) and a backend choice — the "model" whose
+"forward step" is one damped transpose-SpMV power iteration and whose
+"training run" is convergence to the principal eigenvector.  This is
+what `__graft_entry__` exposes and what bench.py times.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..trust.backend import ConvergenceResult, get_backend
+from ..trust.graph import TrustGraph
+
+
+@dataclass
+class EigenTrustModel:
+    graph: TrustGraph
+    alpha: float = 0.1
+    tol: float = 1e-6
+    max_iter: int = 50
+    backend: str = "tpu-sparse"
+    backend_kwargs: dict = field(default_factory=dict)
+
+    def converge(self, **overrides) -> ConvergenceResult:
+        params = dict(alpha=self.alpha, tol=self.tol, max_iter=self.max_iter)
+        params.update(overrides)
+        return get_backend(self.backend, **self.backend_kwargs).converge(
+            self.graph, **params
+        )
+
+    def top_k(self, result: ConvergenceResult, k: int = 10) -> list[tuple[int, float]]:
+        idx = np.argsort(result.scores)[::-1][:k]
+        return [(int(i), float(result.scores[i])) for i in idx]

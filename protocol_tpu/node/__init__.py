@@ -1,0 +1,13 @@
+"""The protocol node: attestation ingest, epoch loop, proof cache, HTTP API.
+
+Rebuild of the reference ``server`` crate (server/src): a daemon that
+replays AttestationCreated events from the chain (or a recorded fixture
+log), validates and caches signed attestations, and every epoch runs
+trust convergence — on a TrustBackend instead of the reference's inline
+5×5 loop — caching a proof of the scores served over ``GET /score``.
+"""
+
+from .attestation import Attestation, AttestationData  # noqa: F401
+from .epoch import Epoch  # noqa: F401
+from .errors import EigenError, EigenErrorCode  # noqa: F401
+from .manager import Manager, ManagerConfig  # noqa: F401

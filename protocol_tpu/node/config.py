@@ -1,0 +1,48 @@
+"""Node configuration (server/src/main.rs:39-45, data/protocol-config.json).
+
+Same JSON shape as the reference so existing config files load
+unchanged, with additive optional fields for the TPU rebuild (trust
+backend, event fixture path)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class ProtocolConfig:
+    epoch_interval: int = 10
+    endpoint: tuple[tuple[int, int, int, int], int] = ((0, 0, 0, 0), 3000)
+    ethereum_node_url: str = "http://localhost:8545"
+    as_contract_address: str = "0x" + "0" * 40
+    # Rebuild-specific (absent from reference configs; defaulted).
+    trust_backend: str = "native-cpu"
+    event_fixture: str | None = None
+
+    @property
+    def host(self) -> str:
+        return ".".join(str(x) for x in self.endpoint[0])
+
+    @property
+    def port(self) -> int:
+        return self.endpoint[1]
+
+    @classmethod
+    def from_json(cls, text: str) -> "ProtocolConfig":
+        obj = json.loads(text)
+        cfg = cls()
+        cfg.epoch_interval = int(obj.get("epoch_interval", cfg.epoch_interval))
+        if "endpoint" in obj:
+            octets, port = obj["endpoint"]
+            cfg.endpoint = (tuple(int(x) for x in octets), int(port))
+        cfg.ethereum_node_url = obj.get("ethereum_node_url", cfg.ethereum_node_url)
+        cfg.as_contract_address = obj.get("as_contract_address", cfg.as_contract_address)
+        cfg.trust_backend = obj.get("trust_backend", cfg.trust_backend)
+        cfg.event_fixture = obj.get("event_fixture", cfg.event_fixture)
+        return cfg
+
+    @classmethod
+    def load(cls, path: str | Path) -> "ProtocolConfig":
+        return cls.from_json(Path(path).read_text())

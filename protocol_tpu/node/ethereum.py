@@ -1,0 +1,158 @@
+"""Chain event ingestion: AttestationCreated replay.
+
+The reference's only peer-to-peer transport is the AttestationStation
+contract's event log, replayed from block 0 on boot
+(server/src/main.rs:139-143, data/AttestationStation.sol:13-18).  Two
+sources implement that here:
+
+- ``FixtureEventSource`` — a JSONL file of recorded events (the test
+  doctrine's "recorded event-log fixtures", SURVEY.md §4 tier 6);
+- ``Web3EventSource``    — live JSON-RPC via web3.py when installed
+  (this image has no web3; the import is gated).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import AsyncIterator, Iterator
+
+from ..crypto.keccak import event_topic
+
+#: keccak256("AttestationCreated(address,address,bytes32,bytes)") — the
+#: event topic emitted by AttestationStation.sol:13-18.
+ATTESTATION_CREATED_TOPIC = (
+    "0x" + event_topic("AttestationCreated(address,address,bytes32,bytes)").hex()
+)
+
+
+@dataclass
+class AttestationCreatedEvent:
+    """Decoded AttestationCreated(creator, about, key, val)."""
+
+    creator: str
+    about: str
+    key: bytes
+    val: bytes
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "creator": self.creator,
+                "about": self.about,
+                "key": "0x" + self.key.hex(),
+                "val": "0x" + self.val.hex(),
+            }
+        )
+
+    @classmethod
+    def from_json(cls, line: str) -> "AttestationCreatedEvent":
+        obj = json.loads(line)
+        return cls(
+            creator=obj["creator"],
+            about=obj["about"],
+            key=bytes.fromhex(obj["key"].removeprefix("0x")),
+            val=bytes.fromhex(obj["val"].removeprefix("0x")),
+        )
+
+
+class FixtureEventSource:
+    """Replays events from a JSONL fixture, then (optionally) tails the
+    file for appended events — the fixture analog of an event
+    subscription."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+
+    def replay(self) -> Iterator[AttestationCreatedEvent]:
+        if not self.path.exists():
+            return
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield AttestationCreatedEvent.from_json(line)
+
+    async def stream(self, poll_interval: float = 0.5) -> AsyncIterator[AttestationCreatedEvent]:
+        """Tail the fixture by byte offset — appended lines are parsed
+        once, never re-reading the prefix."""
+        import asyncio
+
+        offset = 0
+        pending = b""
+        while True:
+            if self.path.exists():
+                with open(self.path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+                offset += len(chunk)
+                pending += chunk
+                while b"\n" in pending:
+                    line, pending = pending.split(b"\n", 1)
+                    line = line.strip()
+                    if line:
+                        yield AttestationCreatedEvent.from_json(line.decode())
+            await asyncio.sleep(poll_interval)
+
+
+class Web3EventSource:
+    """Live AttestationCreated stream over JSON-RPC (ethers-equivalent
+    of server/src/ethereum.rs).  Requires web3.py at runtime."""
+
+    def __init__(self, node_url: str, contract_address: str):
+        try:
+            from web3 import Web3  # type: ignore
+        except ImportError as e:  # pragma: no cover - web3 not in image
+            raise RuntimeError(
+                "web3.py is not installed; use a FixtureEventSource or "
+                "install web3 for live chain ingestion"
+            ) from e
+        self._w3 = Web3(Web3.HTTPProvider(node_url))
+        self.contract_address = contract_address
+
+    def replay(self, from_block: int = 0, to_block=None) -> Iterator[AttestationCreatedEvent]:  # pragma: no cover
+        query = {
+            "fromBlock": from_block,
+            "address": self._w3.to_checksum_address(self.contract_address),
+            "topics": [ATTESTATION_CREATED_TOPIC],
+        }
+        if to_block is not None:
+            query["toBlock"] = to_block
+        for log in self._w3.eth.get_logs(query):
+            yield self._decode(log)
+
+    @staticmethod
+    def _decode(log) -> AttestationCreatedEvent:  # pragma: no cover
+        data = bytes(log["data"])
+        # ABI: dynamic bytes → offset (32) + length (32) + payload.
+        length = int.from_bytes(data[32:64], "big")
+        return AttestationCreatedEvent(
+            creator="0x" + log["topics"][1].hex()[-40:],
+            about="0x" + log["topics"][2].hex()[-40:],
+            key=bytes(log["topics"][3]),
+            val=data[64 : 64 + length],
+        )
+
+    async def stream(self, poll_interval: float = 2.0) -> AsyncIterator[AttestationCreatedEvent]:  # pragma: no cover
+        """Replay from block 0 (server/src/main.rs:139-143) then poll new
+        blocks — the ethers event-stream analog over plain JSON-RPC."""
+        import asyncio
+
+        next_block = 0
+        while True:
+            head = self._w3.eth.block_number
+            if head >= next_block:
+                for ev in self.replay(from_block=next_block, to_block=head):
+                    yield ev
+                next_block = head + 1
+            await asyncio.sleep(poll_interval)
+
+
+def have_web3() -> bool:
+    try:
+        import web3  # noqa: F401
+
+        return True
+    except ImportError:
+        return False

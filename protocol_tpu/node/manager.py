@@ -1,0 +1,193 @@
+"""The Manager: attestation cache and per-epoch score/proof computation.
+
+Rebuild of server/src/manager/mod.rs:72-237.  Differences by design:
+
+- protocol constants are a runtime ``ManagerConfig`` instead of crate
+  consts (manager/mod.rs:32-38);
+- trust convergence runs on a pluggable TrustBackend; the fixed-set path
+  keeps the reference's exact field semantics via ``power_iterate`` so
+  public inputs match bit-for-bit;
+- beyond the fixed set, every valid attestation also feeds an *open
+  graph* (peer-id-indexed edge list) that the TPU backends converge at
+  scale — the capability the reference caps at N=5.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..crypto import calculate_message_hash, field
+from ..crypto.eddsa import PublicKey, sign, verify as verify_sig
+from ..trust.backend import ConvergenceResult, get_backend
+from ..trust.graph import TrustGraph
+from ..trust.native import power_iterate
+from ..zk.proof import PoseidonCommitmentProver, Proof, Prover
+from .attestation import Attestation
+from .bootstrap import FIXED_SET, INITIAL_SCORE, NUM_ITER, NUM_NEIGHBOURS, SCALE, keyset_from_raw
+from .epoch import Epoch
+from .errors import EigenError
+
+
+@dataclass
+class ManagerConfig:
+    num_neighbours: int = NUM_NEIGHBOURS
+    num_iter: int = NUM_ITER
+    initial_score: int = INITIAL_SCORE
+    scale: int = SCALE
+    fixed_set: list[tuple[str, str]] = dc_field(default_factory=lambda: list(FIXED_SET))
+    backend: str = "native-cpu"
+
+
+class Manager:
+    """In-memory attestation store keyed by Poseidon(pk); per-epoch score
+    + proof computation with a proof cache (manager/mod.rs:72-78)."""
+
+    def __init__(self, config: ManagerConfig | None = None, prover: Prover | None = None):
+        self.config = config or ManagerConfig()
+        self.prover = prover or PoseidonCommitmentProver()
+        self.cached_proofs: dict[Epoch, Proof] = {}
+        self.attestations: dict[int, Attestation] = {}
+        self.cached_results: dict[Epoch, ConvergenceResult] = {}
+        _, self._group_pks = keyset_from_raw(self.config.fixed_set)
+        self._group_hashes = [pk.hash() for pk in self._group_pks]
+        # Poseidon pk-hash memo: hashing is 68 field-level rounds of
+        # pure Python; never recompute for a seen key.
+        self._hash_cache: dict[PublicKey, int] = dict(
+            zip(self._group_pks, self._group_hashes)
+        )
+
+    def _pk_hash(self, pk: PublicKey) -> int:
+        h = self._hash_cache.get(pk)
+        if h is None:
+            h = pk.hash()
+            self._hash_cache[pk] = h
+        return h
+
+    # -- ingest ---------------------------------------------------------
+
+    def add_attestation(self, att: Attestation) -> None:
+        """Validate and cache one attestation (manager/mod.rs:95-138):
+        the neighbour list must hash-equal the group, the sender must be
+        a member, and the signature must verify over the protocol
+        message hash."""
+        # Direct pk comparison is equivalent to the reference's
+        # hash-list equality (Poseidon is injective on valid points) and
+        # avoids N permutations per ingest.
+        if att.neighbours != self._group_pks:
+            raise EigenError.invalid_attestation("neighbour group mismatch")
+
+        if att.pk not in self._group_pks:
+            raise EigenError.invalid_attestation("sender not in group")
+        sender_hash = self._pk_hash(att.pk)
+
+        _, message_hashes = calculate_message_hash(att.neighbours, [att.scores])
+        if not verify_sig(att.sig, att.pk, message_hashes[0]):
+            raise EigenError.invalid_attestation("signature verification failed")
+
+        self.attestations[sender_hash] = att
+
+    def get_attestation(self, pk: PublicKey) -> Attestation:
+        att = self.attestations.get(pk.hash())
+        if att is None:
+            raise EigenError.attestation_not_found()
+        return att
+
+    def generate_initial_attestations(self) -> None:
+        """Self-sign uniform IS/N attestations for the whole fixed set
+        (manager/mod.rs:149-167) — the circuit needs a score row from
+        every participant."""
+        cfg = self.config
+        sks, pks = keyset_from_raw(cfg.fixed_set)
+        score = cfg.initial_score // cfg.num_neighbours
+        scores = [[score] * cfg.num_neighbours for _ in range(cfg.num_neighbours)]
+        _, messages = calculate_message_hash(pks, scores)
+        for sk, pk, msg, row in zip(sks, pks, messages, scores):
+            sig = sign(sk, pk, msg)
+            att = Attestation(sig=sig, pk=pk, neighbours=list(pks), scores=list(row))
+            self.attestations[pk.hash()] = att
+
+    # -- per-epoch computation ------------------------------------------
+
+    def gather_ops(self) -> list[list[int]]:
+        """Score matrix in fixed-set order (manager/mod.rs:182-188);
+        KeyError if a member has no attestation, like the reference's
+        unwrap."""
+        return [
+            list(self.attestations[h].scores) for h in self._group_hashes
+        ]
+
+    def calculate_proofs(self, epoch: Epoch) -> None:
+        """Converge the fixed set exactly and cache a proof of the
+        resulting public inputs (manager/mod.rs:170-214)."""
+        cfg = self.config
+        ops = self.gather_ops()
+        init = [cfg.initial_score] * cfg.num_neighbours
+        pub_ins = power_iterate(init, ops, cfg.num_iter, cfg.scale)
+        proof_bytes = self.prover.prove(pub_ins, {"ops": ops})
+        # Debug-parity with the reference's sanity verification
+        # (manager/mod.rs:201-207).
+        if __debug__:
+            assert self.prover.verify(pub_ins, proof_bytes)
+        self.cached_proofs[epoch] = Proof(pub_ins=pub_ins, proof=proof_bytes)
+
+    def converge_epoch(
+        self, epoch: Epoch, *, alpha: float = 0.0, tol: float = 1e-6, max_iter: int = 50
+    ) -> ConvergenceResult:
+        """Scaled path: build the open trust graph from every cached
+        attestation and converge it on the configured TrustBackend."""
+        graph = self.build_graph()
+        result = get_backend(self.config.backend).converge(
+            graph, alpha=alpha, tol=tol, max_iter=max_iter
+        )
+        self.cached_results[epoch] = result
+        return result
+
+    def build_graph(self) -> TrustGraph:
+        """Assemble the open COO graph: peer ids are discovered from
+        attestation senders and neighbours in first-seen order; the
+        fixed set is the pre-trusted seed."""
+        ids: dict[int, int] = {}
+
+        def peer_id(h: int) -> int:
+            if h not in ids:
+                ids[h] = len(ids)
+            return ids[h]
+
+        for h in self._group_hashes:
+            peer_id(h)
+
+        src, dst, w = [], [], []
+        for sender_hash, att in self.attestations.items():
+            s_id = peer_id(sender_hash)
+            for pk, score in zip(att.neighbours, att.scores):
+                if score == 0 or pk.is_null():
+                    continue
+                d_id = peer_id(self._pk_hash(pk))
+                src.append(s_id)
+                dst.append(d_id)
+                w.append(float(score))
+        n = len(ids)
+        pre = np.zeros(n, bool)
+        pre[: len(self._group_hashes)] = True
+        return TrustGraph(
+            n,
+            np.array(src, np.int32),
+            np.array(dst, np.int32),
+            np.array(w, np.float32),
+            pre,
+        )
+
+    # -- queries --------------------------------------------------------
+
+    def get_proof(self, epoch: Epoch) -> Proof:
+        proof = self.cached_proofs.get(epoch)
+        if proof is None:
+            raise EigenError.proof_not_found()
+        return proof
+
+    def get_last_proof(self) -> Proof:
+        if not self.cached_proofs:
+            raise EigenError.proof_not_found()
+        return self.cached_proofs[max(self.cached_proofs, key=lambda e: e.number)]

@@ -1,0 +1,189 @@
+"""The node daemon: HTTP API + epoch timer + chain-event ingestion.
+
+Rebuild of server/src/main.rs:121-187 — the same three-way event loop as
+asyncio tasks instead of tokio ``select!``:
+
+- an HTTP listener serving ``GET /score`` → latest ProofRaw JSON
+  (main.rs:85-119), keep-alive disabled like the reference;
+- an epoch ticker with *Skip* missed-tick semantics (main.rs:129-131): a
+  proof run longer than the interval drops ticks instead of backlogging;
+- an AttestationCreated stream feeding ``Manager.add_attestation``.
+
+Run: ``python -m protocol_tpu.node.server --config data/protocol-config.json``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from dataclasses import dataclass, field
+
+from .config import ProtocolConfig
+from .epoch import Epoch
+from .errors import EigenError
+from .ethereum import FixtureEventSource
+from .manager import Manager, ManagerConfig
+
+log = logging.getLogger("protocol_tpu.node")
+
+BAD_REQUEST = 400
+NOT_FOUND = 404
+INTERNAL_SERVER_ERROR = 500
+
+_STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found", 500: "Internal Server Error"}
+
+
+def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
+    """Route one request (main.rs:85-119).  Returns (status, body)."""
+    if method == "GET" and path == "/score":
+        try:
+            proof = manager.get_last_proof()
+        except EigenError as e:
+            log.info("score query failed: %s", e)
+            return BAD_REQUEST, "InvalidQuery"
+        return 200, proof.to_raw().to_json()
+    return NOT_FOUND, "InvalidRequest"
+
+
+@dataclass
+class Node:
+    config: ProtocolConfig
+    manager: Manager
+    _server: asyncio.AbstractServer | None = field(default=None, repr=False)
+    _tasks: list = field(default_factory=list, repr=False)
+
+    @classmethod
+    def from_config(cls, config: ProtocolConfig) -> "Node":
+        manager = Manager(ManagerConfig(backend=config.trust_backend))
+        return cls(config=config, manager=manager)
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), timeout=10)
+            parts = request_line.decode("latin1").split()
+            if len(parts) < 2:
+                status, body = BAD_REQUEST, "InvalidRequest"
+            else:
+                # Drain headers (connection: close semantics, no body
+                # reads), bounded against slow-loris: at most 100 header
+                # lines within one 10s total deadline.
+                async def drain_headers():
+                    for _ in range(100):
+                        line = await reader.readline()
+                        if line in (b"\r\n", b"\n", b""):
+                            return
+
+                await asyncio.wait_for(drain_headers(), timeout=10)
+                status, body = handle_request(parts[0], parts[1], self.manager)
+            payload = body.encode()
+            writer.write(
+                (
+                    f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}\r\n"
+                    f"content-type: application/json\r\n"
+                    f"content-length: {len(payload)}\r\n"
+                    f"connection: close\r\n\r\n"
+                ).encode()
+                + payload
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError) as e:
+            log.warning("error serving connection: %r", e)
+        finally:
+            writer.close()
+
+    def _epoch_tick(self, epoch: Epoch) -> None:
+        """One epoch of work: the fixed-set proof (reference parity) and,
+        on a TPU backend, open-graph convergence at scale."""
+        self.manager.calculate_proofs(epoch)
+        if self.manager.config.backend != "native-cpu":
+            result = self.manager.converge_epoch(epoch, alpha=0.1)
+            log.info(
+                "epoch %s: open graph n=%d converged in %d iters (resid %.2e) on %s",
+                epoch,
+                len(result.scores),
+                result.iterations,
+                result.residual,
+                result.backend,
+            )
+
+    async def _epoch_loop(self):
+        interval = self.config.epoch_interval
+        while True:
+            await asyncio.sleep(Epoch.secs_until_next_epoch(interval))
+            epoch = Epoch.current_epoch(interval)
+            try:
+                # Proving may outlast the interval; the next sleep
+                # targets the *next* boundary from now = Skip semantics.
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._epoch_tick, epoch
+                )
+                log.info("epoch %s: proof cached", epoch)
+            except Exception as e:
+                log.error("epoch %s: %r", epoch, e)
+
+    def _event_source(self):
+        if self.config.event_fixture:
+            return FixtureEventSource(self.config.event_fixture)
+        from .ethereum import Web3EventSource, have_web3
+
+        if have_web3():
+            return Web3EventSource(
+                self.config.ethereum_node_url, self.config.as_contract_address
+            )
+        log.info("no event fixture configured and web3 not installed; ingest idle")
+        return None
+
+    async def _event_loop(self):
+        source = self._event_source()
+        if source is None:
+            return
+        async for event in source.stream():
+            try:
+                from .attestation import AttestationData
+
+                att_data = AttestationData.from_bytes(
+                    event.val, self.manager.config.num_neighbours
+                )
+                att = att_data.to_attestation(self.manager.config.num_neighbours)
+                self.manager.add_attestation(att)
+                log.info("attestation ingested from %s", event.creator)
+            except (EigenError, ValueError) as e:
+                log.warning("rejected attestation event: %s", e)
+
+    async def start(self) -> None:
+        self.manager.generate_initial_attestations()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.config.host, self.config.port
+        )
+        self._tasks = [
+            asyncio.create_task(self._epoch_loop()),
+            asyncio.create_task(self._event_loop()),
+        ]
+        log.info("listening on http://%s:%s", self.config.host, self.config.port)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="protocol_tpu node")
+    parser.add_argument("--config", default="data/protocol-config.json")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    config = ProtocolConfig.load(args.config)
+    asyncio.run(Node.from_config(config).run_forever())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""jit'd JAX kernels for trust convergence: dense, set-semantics, sparse."""
+
+from .dense import converge_dense, filter_and_normalize, set_converge_dense  # noqa: F401
+from .sparse import converge_sparse, power_step_coo  # noqa: F401

@@ -1,0 +1,98 @@
+"""Dense jit'd trust kernels.
+
+The TPU image of the reference's two native kernels:
+
+- ``converge_dense`` ↔ circuit/src/circuit.rs:425-470 ``native()``:
+  repeated ``opsᵀ·s`` as an MXU matmul under ``lax.scan``.  Operates on
+  *row-normalized* matrices so floating point stays bounded; the field
+  kernel's unscale-by-SCALE^I is algebraically the same normalization.
+- ``set_converge_dense`` + ``filter_and_normalize`` ↔
+  circuit/src/native.rs:83-234: the EigenTrustSet filter/redistribute/
+  normalize semantics re-derived as data-parallel masks (no per-peer
+  Python control flow — everything is `where`-select so XLA fuses it).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("num_iter",))
+def converge_dense(ops_t: jax.Array, s0: jax.Array, num_iter: int) -> jax.Array:
+    """``num_iter`` power-iteration steps ``s ← ops_t @ s``.
+
+    ``ops_t`` is the transposed local-trust matrix (so the contraction is
+    a single matmul on the MXU); pass a column-stochastic matrix and a
+    normalized ``s0`` for bounded dynamics.
+    """
+
+    def step(s, _):
+        return ops_t @ s, None
+
+    s, _ = lax.scan(step, s0, None, length=num_iter)
+    return s
+
+
+@jax.jit
+def filter_and_normalize(
+    ops: jax.Array, match: jax.Array, set_valid: jax.Array
+) -> jax.Array:
+    """Vectorized ``filter_peers`` + credit normalization
+    (circuit/src/native.rs:146-234, 89-102), returning a row-stochastic
+    matrix (zero rows for invalid peers).
+
+    - ``ops[i, j]``: peer i's score for set slot j (already aligned to
+      set order by the caller; a mismatched slot has ``match[i, j] =
+      False``).
+    - ``match[i, j]``: the opinion's j-th public key equals set slot j's.
+    - ``set_valid[i]``: slot i holds a real (non-null) member.
+
+    Nullification: score kept only when the pk matches, the target slot
+    is valid, and it is not a self-score.  All-zero rows of valid peers
+    redistribute evenly over the other valid slots.  Rows are then
+    normalized to sum to 1 (the per-credit share; multiply by credits for
+    reference-scale values).
+    """
+    n = ops.shape[0]
+    eye = jnp.eye(n, dtype=bool)
+    valid_row = set_valid[:, None]
+    valid_col = set_valid[None, :]
+
+    keep = match & valid_col & ~eye & valid_row
+    a = jnp.where(keep, ops, 0.0)
+
+    row_sum = a.sum(axis=1)
+    redistribute = (row_sum == 0.0) & set_valid
+    fallback = valid_col & ~eye & valid_row
+    a = jnp.where(redistribute[:, None] & fallback, 1.0, a)
+
+    row_sum = a.sum(axis=1)
+    safe = jnp.where(row_sum == 0.0, 1.0, row_sum)
+    return a / safe[:, None]
+
+
+@partial(jax.jit, static_argnames=("num_iter",))
+def set_converge_dense(
+    stochastic: jax.Array, credits: jax.Array, num_iter: int
+) -> jax.Array:
+    """EigenTrustSet convergence on a row-stochastic filtered matrix.
+
+    The reference iterates ``s ← Mᵀ s`` where M = diag(credits)·S with S
+    row-stochastic (native.rs:111-133), so raw scores grow by a factor of
+    INITIAL_SCORE per iteration.  On the valid subspace diag(credits) is
+    INITIAL_SCORE·Identity, hence the reference's raw result equals this
+    function's output times ``INITIAL_SCORE^num_iter`` (tests check
+    against the exact rational kernel).
+    """
+    total = credits.sum()
+    s0 = credits / total
+
+    def step(s, _):
+        return stochastic.T @ s, None
+
+    s, _ = lax.scan(step, s0, None, length=num_iter)
+    return s * total

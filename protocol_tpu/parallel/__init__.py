@@ -1,0 +1,11 @@
+"""Device-mesh parallelism: sharded SpMV over ICI collectives.
+
+The rebuild's answer to the reference's "distributed backend" (which is
+an Ethereum event log + HTTP, SURVEY.md §2.5): trust convergence scales
+across chips with `shard_map` over a 1-D `jax.sharding.Mesh`, edges
+sharded, the score vector replicated, and `lax.psum` reducing partial
+transpose-SpMV products over ICI.
+"""
+
+from .mesh import default_mesh, shard_count  # noqa: F401
+from .sharded import ShardedTrustProblem, converge_sharded  # noqa: F401

@@ -1,0 +1,32 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+#: Axis name used by all sharded trust kernels.
+SHARD_AXIS = "shard"
+
+
+def default_mesh(n_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (all by default).
+
+    Trust convergence is a single giant SpMV, so a flat edge-parallel
+    axis is the right layout: partial products travel over ICI via psum;
+    there is no second axis to trade off against (no pipeline/tensor
+    split as in NN workloads).
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        assert n_devices <= len(devices), (
+            f"requested {n_devices} devices, have {len(devices)}"
+        )
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devices), (axis,))
+
+
+def shard_count(mesh: Mesh, axis: str = SHARD_AXIS) -> int:
+    return mesh.shape[axis]

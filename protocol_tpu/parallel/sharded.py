@@ -1,0 +1,156 @@
+"""Edge-sharded trust convergence over a device mesh.
+
+Layout (the scaling-book recipe: pick a mesh, annotate shardings, let
+XLA place collectives):
+
+- **edges** (src, dst, w): sharded on the leading axis across the mesh —
+  each device owns a contiguous dst-sorted slice, padded with w=0 to
+  equal length.  50M edges over 8 chips = 6.25M edges/chip, streamed
+  sequentially from HBM.
+- **t, p, dangling**: replicated (a 1M-peer f32 vector is 4 MB — cheap
+  to replicate, expensive to re-gather per step).
+- per step, inside ``shard_map``: each device computes its partial
+  ``Cᵀt`` by gather-multiply-``segment_sum`` over its edge slice, then a
+  single ``lax.psum`` over ICI produces the full product; damping and L1
+  renorm are elementwise on the replicated result so every device stays
+  consistent without further communication.
+
+This is the distributed analog of the reference's single-threaded
+5×5×10 loop (circuit/src/circuit.rs:434-454) at 10^6 peers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..trust.graph import TrustGraph
+from .mesh import SHARD_AXIS
+
+
+@dataclass
+class ShardedTrustProblem:
+    """Device-resident, mesh-sharded graph data ready for iteration."""
+
+    mesh: Mesh
+    n: int
+    src: jax.Array  # (E_pad,) int32, sharded
+    dst: jax.Array  # (E_pad,) int32, sharded
+    w: jax.Array  # (E_pad,) f32, sharded, row-normalized
+    p: jax.Array  # (n,) f32, replicated
+    dangling: jax.Array  # (n,) f32, replicated
+
+    @classmethod
+    def build(cls, graph: TrustGraph, mesh: Mesh) -> "ShardedTrustProblem":
+        """Host-side assembly: drop self-edges, row-normalize, sort by
+        dst, pad to the mesh size, and place arrays with explicit
+        shardings."""
+        g = graph.drop_self_edges()
+        w, dangling = g.row_normalized()
+        g = TrustGraph(g.n, g.src, g.dst, w, g.pre_trusted)
+        g = g.sorted_by_dst()
+
+        n_shards = mesh.shape[SHARD_AXIS]
+        pad = (-g.nnz) % n_shards
+        src = np.concatenate([g.src, np.zeros(pad, np.int32)])
+        dst = np.concatenate([g.dst, np.zeros(pad, np.int32)])
+        wpad = np.concatenate([g.weight, np.zeros(pad, np.float32)])
+
+        edge_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        repl = NamedSharding(mesh, P())
+        return cls(
+            mesh=mesh,
+            n=g.n,
+            src=jax.device_put(src, edge_sharding),
+            dst=jax.device_put(dst, edge_sharding),
+            w=jax.device_put(wpad, edge_sharding),
+            p=jax.device_put(graph.pre_trust_vector(), repl),
+            dangling=jax.device_put(dangling.astype(np.float32), repl),
+        )
+
+    def t0(self) -> jax.Array:
+        """Initial score vector: the pre-trust distribution (the scaled
+        analog of everyone starting at INITIAL_SCORE)."""
+        return self.p
+
+
+# Compiled runners keyed by (mesh, n): jax's jit cache is keyed on
+# function identity, so rebuilding the closures per call would recompile
+# the whole while_loop every epoch.
+_RUN_CACHE: dict = {}
+
+
+def _get_runner(mesh: Mesh, n: int):
+    key = (mesh, n)
+    if key in _RUN_CACHE:
+        return _RUN_CACHE[key]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P(), P(), P()),
+        out_specs=P(),
+    )
+    def step(src, dst, w, t, p, dangling, alpha):
+        contrib = w * t[src]
+        partial_ct = jax.ops.segment_sum(
+            contrib, dst, num_segments=n, indices_are_sorted=True
+        )
+        ct = lax.psum(partial_ct, SHARD_AXIS)
+        dangling_mass = jnp.sum(t * dangling)
+        t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
+        return t_new / jnp.sum(t_new)
+
+    @partial(jax.jit, static_argnames=("max_iter", "tol"))
+    def run(src, dst, w, t0, p, dangling, alpha, *, max_iter, tol):
+        def body(state):
+            t, _, it = state
+            t_new = step(src, dst, w, t, p, dangling, alpha)
+            return (t_new, t, it + 1)
+
+        def cond(state):
+            t, prev, it = state
+            resid = jnp.sum(jnp.abs(t - prev))
+            return (it < max_iter) & ((it == 0) | (resid > tol))
+
+        init = (t0, jnp.full_like(t0, jnp.inf), jnp.array(0, jnp.int32))
+        if tol <= 0:
+            return lax.fori_loop(0, max_iter, lambda _, s: body(s), init)
+        return lax.while_loop(cond, body, init)
+
+    _RUN_CACHE[key] = run
+    return run
+
+
+def converge_sharded(
+    problem: ShardedTrustProblem,
+    *,
+    alpha: float = 0.1,
+    tol: float = 1e-6,
+    max_iter: int = 50,
+) -> tuple[jax.Array, int, float]:
+    """Damped power iteration to an L1 fixed point on the mesh.
+
+    Returns ``(t, iterations, final residual)``.  ``tol <= 0`` runs
+    exactly ``max_iter`` fixed steps (benchmark mode).
+    """
+    run = _get_runner(problem.mesh, problem.n)
+    t, prev, it = run(
+        problem.src,
+        problem.dst,
+        problem.w,
+        problem.t0(),
+        problem.p,
+        problem.dangling,
+        jnp.float32(alpha),
+        max_iter=max_iter,
+        tol=tol,
+    )
+    return t, int(it), float(jnp.sum(jnp.abs(t - prev)))

@@ -1,0 +1,12 @@
+"""Trust computation: exact native kernels and the TrustBackend registry.
+
+The native kernels reproduce the reference semantics bit-exactly in the
+Bn254 field (circuit/src/circuit.rs::native, circuit/src/native.rs::
+EigenTrustSet); the JAX backends in ``protocol_tpu.ops`` /
+``protocol_tpu.parallel`` compute the same dynamics in floating point at
+scale.  ``backend.get_backend`` selects between them.
+"""
+
+from .backend import ConvergenceResult, TrustBackend, get_backend  # noqa: F401
+from .graph import TrustGraph  # noqa: F401
+from .native import EigenTrustSet, Opinion, power_iterate  # noqa: F401

@@ -1,0 +1,215 @@
+"""TrustBackend — the pluggable execution backend for trust convergence.
+
+The north-star design: the node selects how the epoch's convergence runs
+(BASELINE.json: "native-cpu | tpu-pjrt"), generalized here to four
+backends along the scaling ladder:
+
+- ``native-cpu``   exact field/rational math (parity with the reference)
+- ``tpu-dense``    jit'd dense matmul power iteration (≤ ~10k peers)
+- ``tpu-sparse``   COO segment-sum SpMV, single device
+- ``tpu-sharded``  edge-sharded SpMV + psum over a device mesh
+
+All float backends compute the damped EigenTrust fixed point over the
+row-normalized graph; ``native-cpu`` additionally reproduces the
+reference's field semantics for the proof layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dense import converge_dense
+from ..ops.sparse import converge_sparse
+from .graph import TrustGraph
+
+
+@dataclass
+class ConvergenceResult:
+    """Scores plus convergence metadata."""
+
+    scores: np.ndarray  # (n,) float64, L1-normalized global trust
+    iterations: int
+    residual: float
+    backend: str
+
+    def scaled(self, total: float) -> np.ndarray:
+        """Rescale to reference-style score units (e.g. N·INITIAL_SCORE
+        so a uniform result reads 1000 per peer)."""
+        return self.scores * total
+
+
+class TrustBackend:
+    name = "abstract"
+
+    def converge(
+        self,
+        graph: TrustGraph,
+        *,
+        alpha: float = 0.0,
+        tol: float = 1e-6,
+        max_iter: int = 50,
+    ) -> ConvergenceResult:
+        raise NotImplementedError
+
+
+class NativeCPUBackend(TrustBackend):
+    """Exact rational dense power iteration — small sets only.
+
+    With ``alpha=0`` and ``max_iter=I`` this is the reference kernel
+    modulo normalization: it iterates the row-normalized matrix exactly
+    like ``native()`` iterates the SCALE-summing ops matrix
+    (circuit/src/circuit.rs:434-454), with dangling rows redirected to
+    the pre-trust vector.
+    """
+
+    name = "native-cpu"
+
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+        g = graph.drop_self_edges()
+        dense = g.to_dense()
+        n = g.n
+        # Exact pre-trust vector (the float pre_trust_vector() is this
+        # same distribution rounded to f32).
+        if graph.pre_trusted is not None and graph.pre_trusted.any():
+            cnt = int(graph.pre_trusted.sum())
+            p = [
+                Fraction(1, cnt) if graph.pre_trusted[i] else Fraction(0)
+                for i in range(n)
+            ]
+        else:
+            p = [Fraction(1, n)] * n
+        # Exact rational row-normalized matrix with dangling → p.
+        rows: list[list[Fraction]] = []
+        row_sums = dense.sum(axis=1)
+        for i in range(n):
+            if row_sums[i] <= 0:
+                rows.append([p[j] for j in range(n)])
+            else:
+                s = Fraction(row_sums[i])
+                rows.append([Fraction(dense[i][j]) / s for j in range(n)])
+        a = Fraction(alpha).limit_denominator(10**9)
+        t = list(p)
+        it = 0
+        resid = Fraction(0)
+        for it in range(1, max_iter + 1):
+            new_t = [
+                (1 - a) * sum(rows[j][i] * t[j] for j in range(n)) + a * p[i]
+                for i in range(n)
+            ]
+            resid = sum(abs(x - y) for x, y in zip(new_t, t))
+            t = new_t
+            if tol > 0 and resid < tol:
+                break
+        return ConvergenceResult(
+            scores=np.array([float(x) for x in t], dtype=np.float64),
+            iterations=it,
+            residual=float(resid),
+            backend=self.name,
+        )
+
+
+class DenseJaxBackend(TrustBackend):
+    name = "tpu-dense"
+
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+        g = graph.drop_self_edges()
+        dense = g.to_dense().astype(np.float32)
+        row_sums = dense.sum(axis=1)
+        p = graph.pre_trust_vector().astype(np.float32)
+        dangling = row_sums <= 0
+        norm = np.where(dangling[:, None], p[None, :], dense / np.where(dangling, 1.0, row_sums)[:, None])
+        m = (1.0 - alpha) * norm.T + alpha * np.outer(p, np.ones(g.n, np.float32))
+        t = jnp.asarray(p)
+        m = jnp.asarray(m.astype(np.float32))
+        it = 0
+        resid = np.inf
+        # Fixed-size scan chunks with host-side residual checks between
+        # chunks: keeps the hot loop compiled while honoring tol.
+        chunk = 8 if tol > 0 else max_iter
+        while it < max_iter:
+            steps = min(chunk, max_iter - it)
+            t_new = converge_dense(m, t, steps)
+            t_new = t_new / jnp.sum(t_new)
+            resid = float(jnp.sum(jnp.abs(t_new - t)))
+            t = t_new
+            it += steps
+            if tol > 0 and resid < tol:
+                break
+        return ConvergenceResult(
+            scores=np.asarray(t, dtype=np.float64),
+            iterations=it,
+            residual=resid,
+            backend=self.name,
+        )
+
+
+class SparseJaxBackend(TrustBackend):
+    name = "tpu-sparse"
+
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+        g = graph.drop_self_edges()
+        w, dangling = g.row_normalized()
+        g = TrustGraph(g.n, g.src, g.dst, w, graph.pre_trusted).sorted_by_dst()
+        p = graph.pre_trust_vector()
+        t, it, resid = converge_sparse(
+            jnp.asarray(g.src),
+            jnp.asarray(g.dst),
+            jnp.asarray(g.weight),
+            jnp.asarray(p),
+            jnp.asarray(p),
+            jnp.asarray(dangling.astype(np.float32)),
+            n=g.n,
+            alpha=jnp.float32(alpha),
+            tol=tol,
+            max_iter=max_iter,
+        )
+        return ConvergenceResult(
+            scores=np.asarray(t, dtype=np.float64),
+            iterations=int(it),
+            residual=float(resid),
+            backend=self.name,
+        )
+
+
+class ShardedJaxBackend(TrustBackend):
+    name = "tpu-sharded"
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+        from ..parallel.mesh import default_mesh
+        from ..parallel.sharded import ShardedTrustProblem, converge_sharded
+
+        mesh = self.mesh if self.mesh is not None else default_mesh()
+        problem = ShardedTrustProblem.build(graph, mesh)
+        t, it, resid = converge_sharded(
+            problem, alpha=alpha, tol=tol, max_iter=max_iter
+        )
+        return ConvergenceResult(
+            scores=np.asarray(t, dtype=np.float64),
+            iterations=it,
+            residual=resid,
+            backend=self.name,
+        )
+
+
+_BACKENDS = {
+    "native-cpu": NativeCPUBackend,
+    "tpu-dense": DenseJaxBackend,
+    "tpu-sparse": SparseJaxBackend,
+    "tpu-sharded": ShardedJaxBackend,
+}
+
+
+def get_backend(name: str, **kwargs) -> TrustBackend:
+    try:
+        return _BACKENDS[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown trust backend {name!r}; available: {sorted(_BACKENDS)}"
+        ) from None

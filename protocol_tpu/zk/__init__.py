@@ -1,0 +1,18 @@
+"""ZK proof layer: constraint system, gadget library, EigenTrust circuit.
+
+The reference proves each epoch's convergence with a Halo2 PLONK circuit
+(circuit/src/circuit.rs) verified on-chain via a generated Yul verifier.
+This package rebuilds the proving stack in stages:
+
+- ``proof``       — Proof/ProofRaw wire types (circuit/src/lib.rs:258-292)
+  and the Prover interface the node consumes.
+- ``cs``          — a columnar constraint system with copy constraints and
+  a MockProver-equivalent satisfiability checker (the reference's testing
+  backbone, SURVEY.md §4 tier 2).
+- ``gadgets``     — the arithmetic vocabulary (main gate, bits2num,
+  lt_eq, set membership) as chip/chipset analogs.
+- ``circuit``     — the EigenTrust circuit: message hashing, N EdDSA
+  verifications, the I×N×N power iteration, score conservation.
+"""
+
+from .proof import Proof, ProofRaw, PoseidonCommitmentProver  # noqa: F401

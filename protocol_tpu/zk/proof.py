@@ -1,0 +1,104 @@
+"""Proof wire types and the Prover interface.
+
+``Proof``/``ProofRaw`` mirror circuit/src/lib.rs:258-292: public inputs
+as field elements / 32-byte LE reprs plus opaque proof bytes, JSON round-
+trippable in the same shape the reference serves from ``GET /score``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from ..crypto import field
+from ..crypto.poseidon import permute
+
+
+@dataclass
+class Proof:
+    pub_ins: list[int]
+    proof: bytes
+
+    def to_raw(self) -> "ProofRaw":
+        return ProofRaw(
+            pub_ins=[field.to_le_bytes(x) for x in self.pub_ins], proof=self.proof
+        )
+
+
+@dataclass
+class ProofRaw:
+    pub_ins: list[bytes]
+    proof: bytes
+
+    def to_proof(self) -> Proof:
+        return Proof(
+            pub_ins=[field.from_le_bytes(x) for x in self.pub_ins], proof=self.proof
+        )
+
+    def to_json(self) -> str:
+        # serde serializes [u8; 32] and Vec<u8> as JSON integer arrays.
+        return json.dumps(
+            {
+                "pub_ins": [list(x) for x in self.pub_ins],
+                "proof": list(self.proof),
+            }
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "ProofRaw":
+        obj = json.loads(s)
+        return cls(
+            pub_ins=[bytes(x) for x in obj["pub_ins"]],
+            proof=bytes(obj["proof"]),
+        )
+
+
+class Prover:
+    """Produces proof bytes binding public inputs to a witness."""
+
+    name = "abstract"
+
+    def prove(self, pub_ins: list[int], witness: dict) -> bytes:
+        raise NotImplementedError
+
+    def verify(self, pub_ins: list[int], proof: bytes) -> bool:
+        raise NotImplementedError
+
+
+class PoseidonCommitmentProver(Prover):
+    """Poseidon commitment chain over the public inputs and witness ops.
+
+    NOT zero-knowledge and NOT succinctness-equivalent to the reference's
+    KZG proof — a deterministic binding commitment standing in for the
+    PLONK prover while the circuit layer (protocol_tpu.zk.circuit)
+    provides constraint-level checking.  The wire shape (opaque bytes
+    alongside pub_ins) matches, so the node/client flow is end-to-end
+    testable.
+    """
+
+    name = "poseidon-commitment"
+    DOMAIN = int.from_bytes(b"protocol_tpu.commit.v1".ljust(32, b"\0"), "little") % field.MODULUS
+
+    def _digest(self, pub_ins: list[int], witness: dict) -> int:
+        acc = self.DOMAIN
+        for x in pub_ins:
+            acc = permute([acc, x, 1, 0, 0])[0]
+        for row in witness.get("ops", []):
+            for x in row:
+                acc = permute([acc, x, 2, 0, 0])[0]
+        return acc
+
+    def prove(self, pub_ins: list[int], witness: dict) -> bytes:
+        return field.to_le_bytes(self._digest(pub_ins, witness)) + json.dumps(
+            {"ops": [[int(x) for x in row] for row in witness.get("ops", [])]}
+        ).encode()
+
+    def verify(self, pub_ins: list[int], proof: bytes) -> bool:
+        if len(proof) < 32:
+            return False
+        digest, payload = proof[:32], proof[32:]
+        try:
+            witness = json.loads(payload.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return False
+        return digest == field.to_le_bytes(self._digest(pub_ins, witness))

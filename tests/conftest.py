@@ -1,13 +1,21 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
 Sharded-backend tests exercise real `jax.sharding.Mesh` layouts without
-TPU hardware, per SURVEY.md §4 rebuild test doctrine (tier 5).  Must run
-before the first `import jax` anywhere in the test session.
+TPU hardware, per SURVEY.md §4 rebuild test doctrine (tier 5).  The TPU
+tunnel's site hook force-selects its platform via
+``jax.config.update("jax_platforms", ...)`` at interpreter start, so
+setting the env var is not enough — override the config before any
+backend initializes.  bench.py is what runs on the real chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_platform = os.environ.get("PROTOCOL_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)

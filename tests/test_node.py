@@ -1,0 +1,274 @@
+"""Node layer tests: attestation codec, manager validation, epoch, errors,
+request handler — mirroring server/src tests (SURVEY.md §4 tier 6)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from protocol_tpu.crypto import calculate_message_hash, field
+from protocol_tpu.crypto.eddsa import PublicKey, SecretKey, Signature, sign
+from protocol_tpu.node.attestation import Attestation, AttestationData
+from protocol_tpu.node.bootstrap import (
+    FIXED_SET,
+    INITIAL_SCORE,
+    NUM_NEIGHBOURS,
+    keyset_from_raw,
+    read_bootstrap_csv,
+)
+from protocol_tpu.node.config import ProtocolConfig
+from protocol_tpu.node.epoch import Epoch
+from protocol_tpu.node.errors import EigenError, EigenErrorCode
+from protocol_tpu.node.ethereum import AttestationCreatedEvent, FixtureEventSource
+from protocol_tpu.node.manager import Manager, ManagerConfig
+from protocol_tpu.node.server import handle_request
+from protocol_tpu.zk.proof import ProofRaw
+
+
+class TestEpoch:
+    def test_display(self):
+        assert str(Epoch(123)) == "Epoch(123)"
+
+    def test_next_previous(self):
+        assert Epoch(1).next() == Epoch(2)
+        assert Epoch(1).previous() == Epoch(0)
+
+    def test_be_bytes(self):
+        assert Epoch(0).to_be_bytes() == bytes(8)
+        assert Epoch.from_be_bytes(Epoch(77).to_be_bytes()) == Epoch(77)
+
+    def test_current(self):
+        import time
+
+        interval = 10
+        assert Epoch.current_epoch(interval).number == int(time.time()) // interval
+
+    def test_secs_until_next(self):
+        secs = Epoch.secs_until_next_epoch(10)
+        assert 0 < secs <= 10
+
+
+class TestErrors:
+    def test_codes_stable_both_directions(self):
+        # server/src/error.rs:27-56
+        expected = {
+            EigenErrorCode.INVALID_BOOTSTRAP_PUBKEY: 0,
+            EigenErrorCode.PROVING_ERROR: 1,
+            EigenErrorCode.VERIFICATION_ERROR: 2,
+            EigenErrorCode.CONNECTION_ERROR: 3,
+            EigenErrorCode.LISTEN_ERROR: 4,
+            EigenErrorCode.ATTESTATION_NOT_FOUND: 5,
+            EigenErrorCode.PROOF_NOT_FOUND: 6,
+            EigenErrorCode.INVALID_ATTESTATION: 7,
+            EigenErrorCode.UNKNOWN: 255,
+        }
+        for code, value in expected.items():
+            assert code.value == value
+            assert EigenErrorCode.from_u8(value) == code
+        assert EigenErrorCode.from_u8(99) == EigenErrorCode.UNKNOWN
+
+
+def make_attestation(sender_idx=0, scores=None):
+    sks, pks = keyset_from_raw(FIXED_SET)
+    scores = scores or [200] * NUM_NEIGHBOURS
+    _, msgs = calculate_message_hash(pks, [scores])
+    sig = sign(sks[sender_idx], pks[sender_idx], msgs[0])
+    return Attestation(sig=sig, pk=pks[sender_idx], neighbours=list(pks), scores=scores)
+
+
+class TestAttestationCodec:
+    def test_roundtrip(self):
+        att = make_attestation()
+        data = AttestationData.from_attestation(att)
+        raw = data.to_bytes()
+        # Fixed layout: 32 bytes × (3 sig + 2 pk + 2N neighbours + N scores)
+        assert len(raw) == 32 * (5 + 3 * NUM_NEIGHBOURS)
+        decoded = AttestationData.from_bytes(raw, NUM_NEIGHBOURS).to_attestation(NUM_NEIGHBOURS)
+        assert decoded.pk == att.pk
+        assert decoded.sig == att.sig
+        assert decoded.neighbours == att.neighbours
+        assert decoded.scores == att.scores
+
+    def test_zero_attestation_decodes(self):
+        # attestation.rs:143-168: all-zero payload is representable.
+        raw = bytes(32 * (5 + 3 * NUM_NEIGHBOURS))
+        att = AttestationData.from_bytes(raw, NUM_NEIGHBOURS).to_attestation(NUM_NEIGHBOURS)
+        assert att.pk == PublicKey.null()
+        assert att.scores == [0] * NUM_NEIGHBOURS
+
+    def test_misaligned_rejected(self):
+        with pytest.raises(ValueError):
+            AttestationData.from_bytes(b"\x00" * 31, NUM_NEIGHBOURS)
+
+    def test_short_scores_zero_filled(self):
+        att = make_attestation()
+        data = AttestationData.from_attestation(att)
+        data.scores = data.scores[:2]
+        decoded = data.to_attestation(NUM_NEIGHBOURS)
+        assert decoded.scores[2:] == [0] * (NUM_NEIGHBOURS - 2)
+
+
+class TestManager:
+    def test_add_valid_attestation(self):
+        m = Manager()
+        m.add_attestation(make_attestation())
+        assert len(m.attestations) == 1
+
+    def test_reject_wrong_group(self):
+        m = Manager()
+        att = make_attestation()
+        att.neighbours = list(reversed(att.neighbours))
+        with pytest.raises(EigenError) as exc:
+            m.add_attestation(att)
+        assert exc.value.code == EigenErrorCode.INVALID_ATTESTATION
+
+    def test_reject_outsider_sender(self):
+        m = Manager()
+        att = make_attestation()
+        outsider = SecretKey.random()
+        _, msgs = calculate_message_hash(att.neighbours, [att.scores])
+        att.sig = sign(outsider, outsider.public(), msgs[0])
+        att.pk = outsider.public()
+        with pytest.raises(EigenError):
+            m.add_attestation(att)
+
+    def test_reject_bad_signature(self):
+        m = Manager()
+        att = make_attestation()
+        att.sig = Signature(att.sig.big_r, field.add(att.sig.s, 1))
+        with pytest.raises(EigenError):
+            m.add_attestation(att)
+
+    def test_get_attestation(self):
+        m = Manager()
+        att = make_attestation()
+        m.add_attestation(att)
+        assert m.get_attestation(att.pk) is att
+        with pytest.raises(EigenError):
+            m.get_attestation(SecretKey.random().public())
+
+    def test_should_calculate_proof(self):
+        """manager/mod.rs:246-262: initial attestations converge to the
+        initial scores."""
+        m = Manager()
+        m.generate_initial_attestations()
+        epoch = Epoch(0)
+        m.calculate_proofs(epoch)
+        proof = m.get_proof(epoch)
+        assert proof.pub_ins == [INITIAL_SCORE] * NUM_NEIGHBOURS
+        assert m.prover.verify(proof.pub_ins, proof.proof)
+
+    def test_get_last_proof(self):
+        m = Manager()
+        m.generate_initial_attestations()
+        with pytest.raises(EigenError):
+            m.get_last_proof()
+        m.calculate_proofs(Epoch(3))
+        m.calculate_proofs(Epoch(7))
+        assert m.get_last_proof() is m.get_proof(Epoch(7))
+
+    def test_open_graph_and_epoch_convergence(self):
+        m = Manager(ManagerConfig(backend="tpu-sparse"))
+        m.generate_initial_attestations()
+        graph = m.build_graph()
+        assert graph.n == NUM_NEIGHBOURS
+        assert graph.nnz == NUM_NEIGHBOURS**2  # all uniform scores incl self
+        res = m.converge_epoch(Epoch(1), alpha=0.1)
+        # Symmetric uniform graph → uniform trust.
+        np.testing.assert_allclose(res.scores, [1 / NUM_NEIGHBOURS] * NUM_NEIGHBOURS, rtol=1e-4)
+
+
+class TestHandleRequest:
+    def _ready_manager(self):
+        m = Manager()
+        m.generate_initial_attestations()
+        m.calculate_proofs(Epoch(0))
+        return m
+
+    def test_unknown_route_404(self):
+        # main.rs:196-213
+        status, body = handle_request("GET", "/non_existing_route", Manager())
+        assert (status, body) == (404, "InvalidRequest")
+
+    def test_score_query(self):
+        # main.rs:215-237
+        m = self._ready_manager()
+        status, body = handle_request("GET", "/score", m)
+        assert status == 200
+        raw = ProofRaw.from_json(body)
+        assert raw.to_proof().pub_ins == [INITIAL_SCORE] * NUM_NEIGHBOURS
+
+    def test_score_without_proof_400(self):
+        status, body = handle_request("GET", "/score", Manager())
+        assert (status, body) == (400, "InvalidQuery")
+
+    def test_post_rejected(self):
+        status, _ = handle_request("POST", "/score", self._ready_manager())
+        assert status == 404
+
+
+class TestProofRawJson:
+    def test_roundtrip(self):
+        raw = ProofRaw(pub_ins=[field.to_le_bytes(5)], proof=b"\x01\x02")
+        again = ProofRaw.from_json(raw.to_json())
+        assert again.pub_ins == raw.pub_ins and again.proof == raw.proof
+        # serde shape: integer arrays
+        obj = json.loads(raw.to_json())
+        assert isinstance(obj["pub_ins"][0], list) and isinstance(obj["proof"], list)
+
+
+class TestConfigAndFixtures:
+    def test_protocol_config_parses_reference_shape(self):
+        cfg = ProtocolConfig.load("data/protocol-config.json")
+        assert cfg.epoch_interval == 10
+        assert cfg.host == "0.0.0.0" and cfg.port == 3000
+        assert cfg.trust_backend == "native-cpu"
+
+    def test_bootstrap_csv(self):
+        nodes = read_bootstrap_csv("data/bootstrap-nodes.csv")
+        assert [n.name for n in nodes] == ["Alice", "Bob", "Charlie", "Chuck", "Craig"]
+        assert nodes[0].secret_key().public() == keyset_from_raw(FIXED_SET)[1][0]
+
+    def test_event_fixture_roundtrip(self, tmp_path):
+        att = make_attestation()
+        payload = AttestationData.from_attestation(att).to_bytes()
+        ev = AttestationCreatedEvent(
+            creator="0x" + "11" * 20, about="0x" + "00" * 20, key=bytes(32), val=payload
+        )
+        path = tmp_path / "events.jsonl"
+        path.write_text(ev.to_json() + "\n")
+        events = list(FixtureEventSource(path).replay())
+        assert len(events) == 1
+        decoded = AttestationData.from_bytes(events[0].val, NUM_NEIGHBOURS).to_attestation(
+            NUM_NEIGHBOURS
+        )
+        assert decoded.pk == att.pk
+
+
+class TestNodeEndToEnd:
+    def test_http_server_serves_score(self):
+        """Full socket-level drive: boot the node, query /score."""
+        from protocol_tpu.node.config import ProtocolConfig
+        from protocol_tpu.node.server import Node
+
+        async def scenario():
+            cfg = ProtocolConfig(epoch_interval=3600, endpoint=((127, 0, 0, 1), 0))
+            node = Node.from_config(cfg)
+            await node.start()
+            node.manager.calculate_proofs(Epoch(0))
+            port = node._server.sockets[0].getsockname()[1]
+
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(b"GET /score HTTP/1.1\r\nhost: x\r\n\r\n")
+            await writer.drain()
+            response = await reader.read()
+            writer.close()
+            await node.stop()
+            return response.decode()
+
+        response = asyncio.run(scenario())
+        head, _, body = response.partition("\r\n\r\n")
+        assert "200 OK" in head
+        raw = ProofRaw.from_json(body)
+        assert raw.to_proof().pub_ins == [INITIAL_SCORE] * NUM_NEIGHBOURS

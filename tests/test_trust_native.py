@@ -1,0 +1,211 @@
+"""Exact native trust-kernel tests, mirroring the reference's scenario
+suite (circuit/src/native.rs:237-628, circuit/src/circuit.rs tests)."""
+
+from fractions import Fraction
+
+import pytest
+
+from protocol_tpu.crypto import calculate_message_hash, field
+from protocol_tpu.crypto.eddsa import PublicKey, SecretKey, sign
+from protocol_tpu.trust.native import (
+    EigenTrustSet,
+    Opinion,
+    fraction_to_field,
+    power_iterate,
+    power_iterate_rational,
+)
+
+NUM_NEIGHBOURS = 6
+NUM_ITERATIONS = 20
+INITIAL_SCORE = 1000
+
+
+def sign_opinion(sk, pk, pks, scores):
+    """Build a signed Opinion (native.rs:247-258 test helper)."""
+    _, hashes = calculate_message_hash(pks, [scores])
+    sig = sign(sk, pk, hashes[0])
+    return Opinion(sig=sig, message_hash=hashes[0], scores=list(zip(pks, scores)))
+
+
+def make_set():
+    return EigenTrustSet(
+        num_neighbours=NUM_NEIGHBOURS,
+        num_iterations=NUM_ITERATIONS,
+        initial_score=INITIAL_SCORE,
+    )
+
+
+def keys(n):
+    sks = [SecretKey.random() for _ in range(n)]
+    return sks, [sk.public() for sk in sks]
+
+
+def pad_pks(pks):
+    return pks + [PublicKey.null()] * (NUM_NEIGHBOURS - len(pks))
+
+
+class TestPowerIterate:
+    def test_uniform_preserves_initial_scores(self):
+        """The server's initial-attestation config: every peer gives
+        IS/N to everyone; converged pub_ins equal the initial scores
+        (server/src/manager/mod.rs:246-262)."""
+        n, it, scale = 5, 10, 1000
+        ops = [[200] * n for _ in range(n)]
+        init = [1000] * n
+        out = power_iterate(init, ops, it, scale)
+        assert out == [1000] * n
+
+    def test_field_matches_rational_image(self):
+        """The field result is the Fr image of the exact rational result
+        for arbitrary SCALE-summing score rows."""
+        n, it, scale = 5, 10, 1000
+        ops = [
+            [0, 300, 100, 300, 300],
+            [200, 0, 300, 200, 300],
+            [500, 100, 0, 300, 100],
+            [300, 300, 300, 0, 100],
+            [250, 250, 250, 250, 0],
+        ]
+        init = [1000] * n
+        exact = power_iterate_rational(init, ops, it, scale)
+        via_field = power_iterate(init, ops, it, scale)
+        assert [fraction_to_field(x) for x in exact] == via_field
+        # Total score is conserved (the circuit's Σs == N·IS constraint,
+        # circuit.rs:380-418).
+        assert sum(exact) == n * 1000
+
+    def test_shape_asserts(self):
+        with pytest.raises(AssertionError):
+            power_iterate([1, 2], [[1]], 1, 1000)
+
+
+class TestEigenTrustSet:
+    def test_add_member_twice_panics(self):
+        s = make_set()
+        _, pks = keys(1)
+        s.add_member(pks[0])
+        with pytest.raises(AssertionError):
+            s.add_member(pks[0])
+
+    def test_one_member_converge_panics(self):
+        s = make_set()
+        _, pks = keys(1)
+        s.add_member(pks[0])
+        with pytest.raises((AssertionError, ZeroDivisionError)):
+            s.converge()
+
+    def test_two_members_no_opinions(self):
+        s = make_set()
+        _, pks = keys(2)
+        s.add_member(pks[0])
+        s.add_member(pks[1])
+        out = s.converge_rational()
+        # Empty opinions redistribute evenly: each trusts the other
+        # fully, so mass swaps symmetrically; raw scores grow by a factor
+        # of INITIAL_SCORE per iteration (no unscaling in converge,
+        # native.rs:111-133).
+        expected = INITIAL_SCORE * Fraction(INITIAL_SCORE) ** NUM_ITERATIONS
+        assert out[0] == out[1] == expected
+
+    def test_two_members_with_opinions(self):
+        s = make_set()
+        sks, pks = keys(2)
+        s.add_member(pks[0])
+        s.add_member(pks[1])
+        padded = pad_pks(pks)
+        s.update_op(pks[0], sign_opinion(sks[0], pks[0], padded, [0, INITIAL_SCORE, 0, 0, 0, 0]))
+        s.update_op(pks[1], sign_opinion(sks[1], pks[1], padded, [INITIAL_SCORE, 0, 0, 0, 0, 0]))
+        out = s.converge_rational()
+        assert sum(out) == 2 * INITIAL_SCORE * Fraction(INITIAL_SCORE) ** NUM_ITERATIONS
+
+    def test_three_members_with_opinions(self):
+        s = make_set()
+        sks, pks = keys(3)
+        for pk in pks:
+            s.add_member(pk)
+        padded = pad_pks(pks)
+        scores = [
+            [0, 300, 700, 0, 0, 0],
+            [600, 0, 400, 0, 0, 0],
+            [600, 400, 0, 0, 0, 0],
+        ]
+        for sk, pk, row in zip(sks, pks, scores):
+            s.update_op(pk, sign_opinion(sk, pk, padded, row))
+        out = s.converge_rational()
+        # Rows are normalized to credits=1000 each, so total mass is
+        # multiplied by INITIAL_SCORE per iteration.
+        assert sum(out) == 3 * INITIAL_SCORE * Fraction(INITIAL_SCORE) ** NUM_ITERATIONS
+        # Field image sanity.
+        assert s.converge() == [fraction_to_field(x) for x in out]
+
+    def test_three_members_two_opinions(self):
+        s = make_set()
+        sks, pks = keys(3)
+        for pk in pks:
+            s.add_member(pk)
+        padded = pad_pks(pks)
+        s.update_op(pks[0], sign_opinion(sks[0], pks[0], padded, [0, 300, 700, 0, 0, 0]))
+        s.update_op(pks[1], sign_opinion(sks[1], pks[1], padded, [600, 0, 400, 0, 0, 0]))
+        out = s.converge_rational()
+        assert len(out) == NUM_NEIGHBOURS
+        assert all(x >= 0 for x in out)
+
+    def test_quit_member_reconverges(self):
+        s = make_set()
+        sks, pks = keys(3)
+        for pk in pks:
+            s.add_member(pk)
+        padded = pad_pks(pks)
+        scores = [
+            [0, 300, 700, 0, 0, 0],
+            [600, 0, 400, 0, 0, 0],
+            [600, 400, 0, 0, 0, 0],
+        ]
+        for sk, pk, row in zip(sks, pks, scores):
+            s.update_op(pk, sign_opinion(sk, pk, padded, row))
+        s.converge()
+        s.remove_member(pks[1])
+        out = s.converge_rational()
+        assert out[1] == 0  # removed slot carries no score
+
+    def test_filter_peers(self):
+        """The native.rs:573-627 scenario: mismatched, null and self
+        entries are filtered; every valid peer ends with an opinion."""
+        sks, pks = keys(4)  # pk1, pk2, pk3, pk8
+        sk1, sk2, sk3, _ = sks
+        pk1, pk2, pk3, pk8 = pks
+
+        s = make_set()
+        for pk in (pk1, pk2, pk3):
+            s.add_member(pk)
+
+        null = PublicKey.null()
+        op1 = sign_opinion(sk1, pk1, [pk1, pk2, pk3, null, null, pk8], [10, 10, 0, 0, 10, 0])
+        op2 = sign_opinion(sk2, pk2, [pk1, pk2, pk3, null, null, null], [0, 0, 30, 0, 0, 0])
+        op3 = sign_opinion(sk3, pk3, [pk1, pk2, pk3, null, null, null], [10, 0, 0, 0, 0, 0])
+        s.update_op(pk1, op1)
+        s.update_op(pk2, op2)
+        s.update_op(pk3, op3)
+
+        filtered_set, filtered_ops = s.filter_peers()
+        n_valid = sum(1 for pk, _ in filtered_set if not pk.is_null())
+        assert n_valid == len(filtered_ops) == 3
+        # Peer1's self-score and the score at the empty slot are gone.
+        scores1 = [score for _, score in filtered_ops[pk1].scores]
+        assert scores1 == [0, 10, 0, 0, 0, 0]
+        # Peer3's opinion only scored pk1.
+        scores3 = [score for _, score in filtered_ops[pk3].scores]
+        assert scores3 == [10, 0, 0, 0, 0, 0]
+
+    def test_zero_sum_opinion_redistributes(self):
+        s = make_set()
+        sks, pks = keys(3)
+        for pk in pks:
+            s.add_member(pk)
+        padded = pad_pks(pks)
+        # Peer1 scores only itself → nullified → zero-sum → redistributed
+        # evenly to the other two valid peers.
+        s.update_op(pks[0], sign_opinion(sks[0], pks[0], padded, [1000, 0, 0, 0, 0, 0]))
+        _, filtered_ops = s.filter_peers()
+        scores = [score for _, score in filtered_ops[pks[0]].scores]
+        assert scores == [0, 1, 1, 0, 0, 0]
