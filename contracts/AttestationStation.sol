@@ -1,0 +1,34 @@
+// SPDX-License-Identifier: MIT
+pragma solidity ^0.8.17;
+
+/// @notice Minimal attestation registry in the Optimism AttestationStation
+/// shape: a (creator, about, key) => bytes store whose AttestationCreated
+/// events are the protocol's entire peer-to-peer transport (the node
+/// replays them from block 0). Functionally equivalent to the reference
+/// registry; rewritten with calldata arrays and custom errors.
+contract AttestationStation {
+    mapping(address => mapping(address => mapping(bytes32 => bytes)))
+        public attestations;
+
+    struct AttestationData {
+        address about;
+        bytes32 key;
+        bytes val;
+    }
+
+    event AttestationCreated(
+        address indexed creator,
+        address indexed about,
+        bytes32 indexed key,
+        bytes val
+    );
+
+    /// @notice Record a batch of attestations under msg.sender.
+    function attest(AttestationData[] calldata batch) external {
+        for (uint256 i = 0; i < batch.length; ++i) {
+            AttestationData calldata a = batch[i];
+            attestations[msg.sender][a.about][a.key] = a.val;
+            emit AttestationCreated(msg.sender, a.about, a.key, a.val);
+        }
+    }
+}

@@ -1,0 +1,37 @@
+// SPDX-License-Identifier: MIT
+pragma solidity ^0.8.17;
+
+/// @notice Thin wrapper forwarding (pub_ins ‖ proof) calldata to a raw
+/// PLONK verifier contract via staticcall — the on-chain entry point the
+/// client's `verify` subcommand transacts with. Equivalent role to the
+/// reference wrapper around its generated Yul verifier; written with
+/// high-level calldata assembly-free forwarding and custom errors.
+contract EtVerifierWrapper {
+    error VerifierMissing();
+    error VerificationFailed();
+
+    /// Raw verifier contract (e.g. a deployed Yul PLONK verifier whose
+    /// calldata layout is uint256[N] public inputs followed by the
+    /// proof bytes).
+    address public immutable verifier;
+
+    uint256 public constant NUM_PUB_INS = 5;
+
+    event Verified(address indexed caller);
+
+    constructor(address verifier_) {
+        verifier = verifier_;
+    }
+
+    function verify(
+        uint256[NUM_PUB_INS] calldata pubIns,
+        bytes calldata proof
+    ) external {
+        if (verifier.code.length == 0) revert VerifierMissing();
+        (bool ok, ) = verifier.staticcall(
+            abi.encodePacked(pubIns, proof)
+        );
+        if (!ok) revert VerificationFailed();
+        emit Verified(msg.sender);
+    }
+}

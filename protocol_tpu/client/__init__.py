@@ -1,0 +1,9 @@
+"""Client wallet: build/sign/submit attestations, fetch and verify proofs.
+
+Rebuild of the reference ``client`` crate (client/src): a CLI with
+show / compile-contracts / deploy-contracts / attest / update / verify
+subcommands and an EigenTrustClient that signs the configured score
+vector and submits it to the AttestationStation.
+"""
+
+from .client import ClientConfig, EigenTrustClient  # noqa: F401

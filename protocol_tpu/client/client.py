@@ -1,0 +1,210 @@
+"""EigenTrustClient and its configuration (client/src/lib.rs:31-150).
+
+Chain submission is transport-pluggable: a ``Transport`` either sends a
+real transaction (web3, when installed) or appends the encoded event to
+a fixture log (the zero-dependency path used in tests and air-gapped
+runs — the node ingests either identically).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field as dc_field
+from pathlib import Path
+
+from ..crypto import calculate_message_hash, field
+from ..crypto.eddsa import SecretKey, sign
+from ..node.attestation import Attestation, AttestationData
+from ..node.bootstrap import BootstrapNode, keyset_from_raw
+from ..node.ethereum import AttestationCreatedEvent
+from ..zk.proof import ProofRaw
+
+
+class ClientError(Exception):
+    pass
+
+
+@dataclass
+class ClientConfig:
+    """client-config.json shape (client/src/lib.rs:31-40)."""
+
+    ops: list[int]
+    secret_key: tuple[str, str]
+    as_address: str
+    et_verifier_wrapper_address: str
+    mnemonic: str
+    ethereum_node_url: str
+    server_url: str
+    event_fixture: str | None = None
+
+    @classmethod
+    def from_json(cls, text: str) -> "ClientConfig":
+        obj = json.loads(text)
+        return cls(
+            ops=[int(x) for x in obj["ops"]],
+            secret_key=(obj["secret_key"][0], obj["secret_key"][1]),
+            as_address=obj["as_address"],
+            et_verifier_wrapper_address=obj["et_verifier_wrapper_address"],
+            mnemonic=obj["mnemonic"],
+            ethereum_node_url=obj["ethereum_node_url"],
+            server_url=obj["server_url"],
+            event_fixture=obj.get("event_fixture"),
+        )
+
+    def to_json(self) -> str:
+        out = {
+            "ops": self.ops,
+            "secret_key": list(self.secret_key),
+            "as_address": self.as_address,
+            "et_verifier_wrapper_address": self.et_verifier_wrapper_address,
+            "mnemonic": self.mnemonic,
+            "ethereum_node_url": self.ethereum_node_url,
+            "server_url": self.server_url,
+        }
+        if self.event_fixture:
+            out["event_fixture"] = self.event_fixture
+        return json.dumps(out, indent=4)
+
+    @classmethod
+    def load(cls, path: str | Path) -> "ClientConfig":
+        return cls.from_json(Path(path).read_text())
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(self.to_json() + "\n")
+
+
+@dataclass
+class EigenTrustClient:
+    config: ClientConfig
+    user_secrets: list[BootstrapNode] = dc_field(default_factory=list)
+
+    def _identity(self) -> SecretKey:
+        return SecretKey.from_bs58(*self.config.secret_key)
+
+    def _build(self) -> tuple[Attestation, int]:
+        """Sign the configured score vector over the bootstrap set
+        (client/src/lib.rs:54-97); returns the attestation and the
+        group pks_hash (the AttestationStation key)."""
+        pairs = [(n.sk0, n.sk1) for n in self.user_secrets]
+        _, user_publics = keyset_from_raw(pairs)
+
+        sk = self._identity()
+        pk = sk.public()
+        ops = [field.from_u128(x) for x in self.config.ops]
+        pks_hash, message_hashes = calculate_message_hash(user_publics, [ops])
+        sig = sign(sk, pk, message_hashes[0])
+        return Attestation(sig=sig, pk=pk, neighbours=user_publics, scores=ops), pks_hash
+
+    def build_attestation(self) -> Attestation:
+        return self._build()[0]
+
+    def attest(self) -> AttestationCreatedEvent:
+        """Build, sign, and submit the attestation
+        (client/src/lib.rs:54-120).  Returns the event as submitted."""
+        att, pks_hash = self._build()
+        payload = AttestationData.from_attestation(att).to_bytes()
+
+        event = AttestationCreatedEvent(
+            creator="0x" + "00" * 20,
+            about="0x" + "00" * 20,
+            key=field.to_le_bytes(pks_hash),
+            val=payload,
+        )
+        if self.config.event_fixture:
+            with open(self.config.event_fixture, "a") as f:
+                f.write(event.to_json() + "\n")
+            return event
+        return self._attest_web3(event)
+
+    def _attest_web3(self, event: AttestationCreatedEvent) -> AttestationCreatedEvent:
+        """Submit via eth_sendTransaction through web3 (requires web3 and
+        an unlocked dev account, e.g. Anvil)."""
+        try:
+            from web3 import Web3  # type: ignore
+        except ImportError as e:
+            raise ClientError(
+                "web3 is not installed and no event_fixture configured"
+            ) from e
+        from ..crypto.keccak import selector
+
+        w3 = Web3(Web3.HTTPProvider(self.config.ethereum_node_url))
+        calldata = selector("attest((address,bytes32,bytes)[])") + abi_encode_attest(
+            event.about, event.key, event.val
+        )
+        tx = {
+            "from": w3.eth.accounts[0],
+            "to": w3.to_checksum_address(self.config.as_address),
+            "data": "0x" + calldata.hex(),
+        }
+        tx_hash = w3.eth.send_transaction(tx)
+        receipt = w3.eth.wait_for_transaction_receipt(tx_hash)
+        if receipt["status"] != 1:
+            raise ClientError("attest transaction reverted")
+        return event
+
+    def fetch_proof(self) -> ProofRaw:
+        """GET {server_url}/score (client/src/main.rs:105-107)."""
+        url = f"{self.config.server_url}/score"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = resp.read().decode()
+        return ProofRaw.from_json(body)
+
+    def verify(self, proof_raw: ProofRaw) -> bool:
+        """Verify the fetched proof: on-chain via the EtVerifierWrapper
+        when web3 is available (client/src/lib.rs:122-149), otherwise
+        locally with the framework prover."""
+        try:
+            import web3  # type: ignore  # noqa: F401
+
+            return self._verify_web3(proof_raw)
+        except ImportError:
+            proof = proof_raw.to_proof()
+            from ..zk.proof import PoseidonCommitmentProver
+
+            return PoseidonCommitmentProver().verify(proof.pub_ins, proof.proof)
+
+    def _verify_web3(self, proof_raw: ProofRaw) -> bool:
+        """Transact EtVerifierWrapper.verify(uint256[5], bytes)
+        (client/src/lib.rs:122-149)."""
+        from web3 import Web3  # type: ignore
+
+        from ..crypto.keccak import selector
+
+        n = len(proof_raw.pub_ins)
+        w3 = Web3(Web3.HTTPProvider(self.config.ethereum_node_url))
+        pub_words = b"".join(
+            int.from_bytes(x, "little").to_bytes(32, "big") for x in proof_raw.pub_ins
+        )
+        proof = proof_raw.proof
+        # verify(uint256[N],bytes): N inline words, bytes offset, then
+        # the bytes tail.
+        calldata = (
+            selector(f"verify(uint256[{n}],bytes)")
+            + pub_words
+            + ((n + 1) * 32).to_bytes(32, "big")
+            + len(proof).to_bytes(32, "big")
+            + proof
+            + b"\x00" * ((-len(proof)) % 32)
+        )
+        tx = {
+            "from": w3.eth.accounts[0],
+            "to": w3.to_checksum_address(self.config.et_verifier_wrapper_address),
+            "data": "0x" + calldata.hex(),
+        }
+        receipt = w3.eth.wait_for_transaction_receipt(w3.eth.send_transaction(tx))
+        return receipt["status"] == 1
+
+
+def abi_encode_attest(about: str, key: bytes, val: bytes) -> bytes:
+    """ABI-encode ``attest(AttestationData[])`` calldata for one entry:
+    (address about, bytes32 key, bytes val)[]."""
+    def word(x: int) -> bytes:
+        return x.to_bytes(32, "big")
+
+    about_b = bytes.fromhex(about.removeprefix("0x")).rjust(32, b"\x00")
+    val_padded = val + b"\x00" * ((-len(val)) % 32)
+    # outer: offset to array; array: len, offset to elem; elem: about,
+    # key, offset to bytes, bytes len, bytes data.
+    elem = about_b + key + word(0x60) + word(len(val)) + val_padded
+    return word(0x20) + word(1) + word(0x20) + elem

@@ -1,0 +1,175 @@
+"""Client tests including the full protocol loop over a fixture chain:
+attest → node ingest → epoch convergence → fetch proof → verify
+(the Anvil-less analog of client/src/lib.rs:165-240, SURVEY.md §4 tier 6)."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import pytest
+
+from protocol_tpu.client.cli import main as cli_main
+from protocol_tpu.client.client import ClientConfig, EigenTrustClient, abi_encode_attest
+from protocol_tpu.node.attestation import AttestationData
+from protocol_tpu.node.bootstrap import NUM_NEIGHBOURS, read_bootstrap_csv
+from protocol_tpu.node.manager import Manager
+from protocol_tpu.node.epoch import Epoch
+
+DATA = Path(__file__).resolve().parents[1] / "data"
+
+
+def make_config(tmp_path, **overrides):
+    cfg = ClientConfig.load(DATA / "client-config.json")
+    cfg.event_fixture = str(tmp_path / "events.jsonl")
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def bootstrap_nodes():
+    return read_bootstrap_csv(DATA / "bootstrap-nodes.csv")
+
+
+class TestClientAttest:
+    def test_attestation_is_node_valid(self, tmp_path):
+        """The client's signed attestation passes the manager's full
+        validation (the should_add_attestation analog)."""
+        cfg = make_config(tmp_path)
+        client = EigenTrustClient(cfg, bootstrap_nodes())
+        att = client.build_attestation()
+        Manager().add_attestation(att)  # raises on any invalidity
+        assert att.scores == [300, 100, 100, 300, 200]
+
+    def test_attest_writes_fixture_event(self, tmp_path):
+        cfg = make_config(tmp_path)
+        client = EigenTrustClient(cfg, bootstrap_nodes())
+        event = client.attest()
+        lines = Path(cfg.event_fixture).read_text().strip().splitlines()
+        assert len(lines) == 1
+        decoded = AttestationData.from_bytes(event.val, NUM_NEIGHBOURS)
+        att = decoded.to_attestation(NUM_NEIGHBOURS)
+        assert att.pk == client.build_attestation().pk
+
+    def test_full_protocol_loop(self, tmp_path):
+        """attest → node ingests fixture → epoch proof → /score fetch →
+        client-side verification."""
+        from protocol_tpu.node.config import ProtocolConfig
+        from protocol_tpu.node.server import Node
+
+        cfg = make_config(tmp_path)
+        client = EigenTrustClient(cfg, bootstrap_nodes())
+        client.attest()
+
+        async def scenario():
+            node_cfg = ProtocolConfig(
+                epoch_interval=3600,
+                endpoint=((127, 0, 0, 1), 0),
+                event_fixture=cfg.event_fixture,
+            )
+            node = Node.from_config(node_cfg)
+            await node.start()
+            # start() pre-fills uniform initial attestations; wait until
+            # the fixture stream has replaced Alice's row (polling is
+            # 0.5s; a fixed sleep would be timing-flaky).
+            alice_hash = client.build_attestation().pk.hash()
+            for _ in range(100):
+                att = node.manager.attestations.get(alice_hash)
+                if att is not None and att.scores == [300, 100, 100, 300, 200]:
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("fixture event was not ingested")
+            node.manager.calculate_proofs(Epoch(0))
+            port = node._server.sockets[0].getsockname()[1]
+            cfg.server_url = f"http://127.0.0.1:{port}"
+            loop = asyncio.get_running_loop()
+            proof_raw = await loop.run_in_executor(None, client.fetch_proof)
+            await node.stop()
+            # Alice's attested row must be in the proof witness.
+            payload = json.loads(proof_raw.proof[32:].decode())
+            return proof_raw, payload
+
+        proof_raw, payload = asyncio.run(scenario())
+        assert client.verify(proof_raw)
+        assert [300, 100, 100, 300, 200] in payload["ops"]
+
+
+class TestAbiEncoding:
+    def test_attest_calldata_layout(self):
+        data = abi_encode_attest("0x" + "11" * 20, b"\x22" * 32, b"\xab\xcd")
+        # head: array offset, length 1, element offset
+        assert data[:32] == (0x20).to_bytes(32, "big")
+        assert data[32:64] == (1).to_bytes(32, "big")
+        assert data[96:128].endswith(b"\x11" * 20)  # about address
+        assert data[128:160] == b"\x22" * 32  # key
+        assert data[192:224] == (2).to_bytes(32, "big")  # bytes length
+        assert data[224:226] == b"\xab\xcd"
+
+
+class TestCli:
+    def _data_dir(self, tmp_path):
+        d = tmp_path / "data"
+        d.mkdir()
+        for name in ("client-config.json", "bootstrap-nodes.csv"):
+            (d / name).write_text((DATA / name).read_text())
+        return d
+
+    def test_show(self, tmp_path, capsys):
+        cli_main(["--data-dir", str(self._data_dir(tmp_path)), "show"])
+        out = capsys.readouterr().out
+        assert json.loads(out)["ops"] == [300, 100, 100, 300, 200]
+
+    def test_update_score(self, tmp_path, capsys):
+        d = self._data_dir(tmp_path)
+        cli_main(["--data-dir", str(d), "update", "score", "Bob 777"])
+        cfg = ClientConfig.load(d / "client-config.json")
+        assert cfg.ops[1] == 777
+
+    def test_update_score_unknown_name(self, tmp_path):
+        with pytest.raises(SystemExit, match="Invalid neighbour name"):
+            cli_main(["--data-dir", str(self._data_dir(tmp_path)), "update", "score", "Mallory 1"])
+
+    def test_update_bad_field(self, tmp_path):
+        with pytest.raises(SystemExit, match="Invalid config field"):
+            cli_main(["--data-dir", str(self._data_dir(tmp_path)), "update", "nope", "x"])
+
+    def test_update_missing_value(self, tmp_path):
+        with pytest.raises(SystemExit, match="provide the update data"):
+            cli_main(["--data-dir", str(self._data_dir(tmp_path)), "update", "score"])
+
+    def test_update_address_validated(self, tmp_path):
+        d = self._data_dir(tmp_path)
+        with pytest.raises(SystemExit, match="Failed to parse address"):
+            cli_main(["--data-dir", str(d), "update", "as_address", "nothex"])
+        cli_main(["--data-dir", str(d), "update", "as_address", "0x" + "ab" * 20])
+        assert ClientConfig.load(d / "client-config.json").as_address == "0x" + "ab" * 20
+
+    def test_update_node_url_validated(self, tmp_path):
+        with pytest.raises(SystemExit, match="Failed to parse node url"):
+            cli_main(["--data-dir", str(self._data_dir(tmp_path)), "update", "node_url", "ftp://x"])
+
+    def test_update_sk_validated(self, tmp_path):
+        with pytest.raises(SystemExit, match="expected 2 bs58 values"):
+            cli_main(["--data-dir", str(self._data_dir(tmp_path)), "update", "sk", "only-one"])
+
+    def test_unknown_identity_rejected_for_signing_commands(self, tmp_path):
+        d = self._data_dir(tmp_path)
+        cfg = ClientConfig.load(d / "client-config.json")
+        cfg.secret_key = ("1111", "2222")
+        cfg.save(d / "client-config.json")
+        with pytest.raises(SystemExit, match="not in bootstrap-nodes.csv"):
+            cli_main(["--data-dir", str(d), "attest"])
+        # Config-repair commands still work with a bad identity...
+        cli_main(["--data-dir", str(d), "show"])
+        # ...including update sk back to a bootstrap identity.
+        nodes = read_bootstrap_csv(d / "bootstrap-nodes.csv")
+        cli_main(["--data-dir", str(d), "update", "sk", f"{nodes[1].sk0},{nodes[1].sk1}"])
+        assert ClientConfig.load(d / "client-config.json").secret_key == (
+            nodes[1].sk0,
+            nodes[1].sk1,
+        )
+
+    def test_update_sk_rejects_non_bootstrap_key(self, tmp_path):
+        d = self._data_dir(tmp_path)
+        with pytest.raises(SystemExit, match="not one of the bootstrap identities"):
+            cli_main(["--data-dir", str(d), "update", "sk", "1111,2222"])
