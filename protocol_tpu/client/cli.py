@@ -79,18 +79,20 @@ def cmd_deploy_contracts(config: ClientConfig, _nodes, data_dir: Path) -> None:
     """Deploy AttestationStation, the raw PLONK verifier (from a
     provided bytecode artifact), and the wrapper pointing at it
     (client/src/main.rs:79-100)."""
-    try:
-        from web3 import Web3  # type: ignore
-    except ImportError:
-        raise SystemExit("web3 is not installed; deploy requires a chain connection")
-    build = Path(__file__).resolve().parents[2] / "contracts" / "build"
-    w3 = Web3(Web3.HTTPProvider(config.ethereum_node_url))
+    from .client import ClientError, _web3, web3_transact
 
-    def deploy(name: str, data: str) -> str:
-        receipt = w3.eth.wait_for_transaction_receipt(
-            w3.eth.send_transaction({"from": w3.eth.accounts[0], "data": data})
-        )
-        if receipt["status"] != 1:
+    build = Path(__file__).resolve().parents[2] / "contracts" / "build"
+    try:
+        w3 = _web3(config.ethereum_node_url)
+    except ClientError as e:
+        raise SystemExit(str(e))
+
+    def deploy(name: str, bytecode_hex: str) -> str:
+        try:
+            receipt = web3_transact(
+                w3, {"from": w3.eth.accounts[0], "data": "0x" + bytecode_hex}
+            )
+        except ClientError:
             raise SystemExit(f"{name} deployment reverted")
         addr = receipt["contractAddress"]
         if len(w3.eth.get_code(addr)) == 0:
@@ -98,30 +100,38 @@ def cmd_deploy_contracts(config: ClientConfig, _nodes, data_dir: Path) -> None:
         print(f"{name} deployed. Address: {addr}")
         return addr
 
+    def load_bytecode(path: Path) -> str:
+        """Accept solc hex-text output or raw binary creation bytecode
+        (the generated-verifier artifact form)."""
+        raw = path.read_bytes()
+        try:
+            text = raw.decode("ascii").strip().removeprefix("0x")
+            bytes.fromhex(text)
+            return text
+        except (UnicodeDecodeError, ValueError):
+            return raw.hex()
+
     as_bin = build / "AttestationStation.bin"
     if not as_bin.exists():
         raise SystemExit(f"{as_bin} missing; run compile-contracts first")
-    deploy("AttestationStation", "0x" + as_bin.read_text().strip())
+    deploy("AttestationStation", load_bytecode(as_bin))
 
-    # The raw verifier is an external artifact (generated PLONK
-    # verifier bytecode, hex): data/et_verifier.bin if present.
+    # The raw verifier is an external artifact (generated PLONK verifier
+    # creation bytecode): data/et_verifier.bin if present.
     verifier_bin = data_dir / "et_verifier.bin"
     if not verifier_bin.exists():
         print(
             f"no raw verifier artifact at {verifier_bin}; skipping verifier + wrapper deploy"
         )
         return
-    verifier_addr = deploy("EtVerifier", "0x" + verifier_bin.read_text().strip())
+    verifier_addr = deploy("EtVerifier", load_bytecode(verifier_bin))
 
     wrapper_bin = build / "EtVerifierWrapper.bin"
     if not wrapper_bin.exists():
         raise SystemExit(f"{wrapper_bin} missing; run compile-contracts first")
     # Constructor takes (address verifier_): append the ABI-encoded arg.
     ctor_arg = bytes.fromhex(verifier_addr.removeprefix("0x")).rjust(32, b"\x00")
-    deploy(
-        "EtVerifierWrapper",
-        "0x" + wrapper_bin.read_text().strip() + ctor_arg.hex(),
-    )
+    deploy("EtVerifierWrapper", load_bytecode(wrapper_bin) + ctor_arg.hex())
 
 
 def cmd_update(config: ClientConfig, nodes, field: str | None, value: str | None, data_dir: Path) -> None:
@@ -151,8 +161,12 @@ def cmd_update(config: ClientConfig, nodes, field: str | None, value: str | None
         if len(parts) != 2:
             raise SystemExit('Invalid input format. Expected: "Alice 100"')
         name, score = parts
+        # u128 semantics: non-negative integers only (a negative value
+        # would wrap to a near-modulus field element at attest time).
         try:
             score_val = int(score)
+            if score_val < 0 or score_val >= 1 << 128:
+                raise ValueError
         except ValueError:
             raise SystemExit("Failed to parse score.")
         names = [n.name for n in nodes]

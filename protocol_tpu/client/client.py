@@ -25,6 +25,29 @@ class ClientError(Exception):
     pass
 
 
+#: EtVerifierWrapper.NUM_PUB_INS (contracts/EtVerifierWrapper.sol).
+ET_WRAPPER_NUM_PUB_INS = 5
+
+
+def _web3(node_url: str):
+    """Shared web3 construction (raises ClientError when absent)."""
+    try:
+        from web3 import Web3  # type: ignore
+    except ImportError as e:
+        raise ClientError("web3 is not installed; chain mode unavailable") from e
+    return Web3(Web3.HTTPProvider(node_url))
+
+
+def web3_transact(w3, tx: dict):
+    """Send a transaction and wait for its receipt, raising ClientError
+    on revert — the one transact/wait/status path used by attest,
+    verify, and deploy."""
+    receipt = w3.eth.wait_for_transaction_receipt(w3.eth.send_transaction(tx))
+    if receipt["status"] != 1:
+        raise ClientError("transaction reverted")
+    return receipt
+
+
 @dataclass
 class ClientConfig:
     """client-config.json shape (client/src/lib.rs:31-40)."""
@@ -120,27 +143,20 @@ class EigenTrustClient:
     def _attest_web3(self, event: AttestationCreatedEvent) -> AttestationCreatedEvent:
         """Submit via eth_sendTransaction through web3 (requires web3 and
         an unlocked dev account, e.g. Anvil)."""
-        try:
-            from web3 import Web3  # type: ignore
-        except ImportError as e:
-            raise ClientError(
-                "web3 is not installed and no event_fixture configured"
-            ) from e
         from ..crypto.keccak import selector
 
-        w3 = Web3(Web3.HTTPProvider(self.config.ethereum_node_url))
+        w3 = _web3(self.config.ethereum_node_url)
         calldata = selector("attest((address,bytes32,bytes)[])") + abi_encode_attest(
             event.about, event.key, event.val
         )
-        tx = {
-            "from": w3.eth.accounts[0],
-            "to": w3.to_checksum_address(self.config.as_address),
-            "data": "0x" + calldata.hex(),
-        }
-        tx_hash = w3.eth.send_transaction(tx)
-        receipt = w3.eth.wait_for_transaction_receipt(tx_hash)
-        if receipt["status"] != 1:
-            raise ClientError("attest transaction reverted")
+        web3_transact(
+            w3,
+            {
+                "from": w3.eth.accounts[0],
+                "to": w3.to_checksum_address(self.config.as_address),
+                "data": "0x" + calldata.hex(),
+            },
+        )
         return event
 
     def fetch_proof(self) -> ProofRaw:
@@ -150,29 +166,39 @@ class EigenTrustClient:
             body = resp.read().decode()
         return ProofRaw.from_json(body)
 
+    def use_chain(self) -> bool:
+        """On-chain mode iff no fixture is configured and a wrapper
+        address is set — NOT keyed on web3 importability, so the
+        fixture/air-gapped path keeps working in web3-equipped
+        environments."""
+        has_wrapper = bool(
+            self.config.et_verifier_wrapper_address.strip().removeprefix("0x").strip("0")
+        )
+        return self.config.event_fixture is None and has_wrapper
+
     def verify(self, proof_raw: ProofRaw) -> bool:
         """Verify the fetched proof: on-chain via the EtVerifierWrapper
-        when web3 is available (client/src/lib.rs:122-149), otherwise
-        locally with the framework prover."""
-        try:
-            import web3  # type: ignore  # noqa: F401
-
+        in chain mode (client/src/lib.rs:122-149), otherwise locally
+        with the framework prover."""
+        if self.use_chain():
             return self._verify_web3(proof_raw)
-        except ImportError:
-            proof = proof_raw.to_proof()
-            from ..zk.proof import PoseidonCommitmentProver
+        proof = proof_raw.to_proof()
+        from ..zk.proof import PoseidonCommitmentProver
 
-            return PoseidonCommitmentProver().verify(proof.pub_ins, proof.proof)
+        return PoseidonCommitmentProver().verify(proof.pub_ins, proof.proof)
 
     def _verify_web3(self, proof_raw: ProofRaw) -> bool:
         """Transact EtVerifierWrapper.verify(uint256[5], bytes)
-        (client/src/lib.rs:122-149)."""
-        from web3 import Web3  # type: ignore
-
+        (client/src/lib.rs:122-149).  A reverting verifier (bad proof)
+        returns False rather than raising."""
         from ..crypto.keccak import selector
 
         n = len(proof_raw.pub_ins)
-        w3 = Web3(Web3.HTTPProvider(self.config.ethereum_node_url))
+        if n != ET_WRAPPER_NUM_PUB_INS:
+            raise ClientError(
+                f"wrapper expects {ET_WRAPPER_NUM_PUB_INS} public inputs, got {n}"
+            )
+        w3 = _web3(self.config.ethereum_node_url)
         pub_words = b"".join(
             int.from_bytes(x, "little").to_bytes(32, "big") for x in proof_raw.pub_ins
         )
@@ -192,7 +218,14 @@ class EigenTrustClient:
             "to": w3.to_checksum_address(self.config.et_verifier_wrapper_address),
             "data": "0x" + calldata.hex(),
         }
-        receipt = w3.eth.wait_for_transaction_receipt(w3.eth.send_transaction(tx))
+        try:
+            receipt = web3_transact(w3, tx)
+        except ClientError:
+            return False  # wrapper reverted: VerificationFailed
+        except Exception as e:  # gas-estimation revert surfaces pre-send
+            if "revert" in str(e).lower() or type(e).__name__ == "ContractLogicError":
+                return False
+            raise
         return receipt["status"] == 1
 
 

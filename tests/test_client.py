@@ -125,6 +125,11 @@ class TestCli:
         cfg = ClientConfig.load(d / "client-config.json")
         assert cfg.ops[1] == 777
 
+    def test_update_score_rejects_negative(self, tmp_path):
+        # u128 parse semantics (client/src/main.rs:167-170).
+        with pytest.raises(SystemExit, match="Failed to parse score"):
+            cli_main(["--data-dir", str(self._data_dir(tmp_path)), "update", "score", "Bob -5"])
+
     def test_update_score_unknown_name(self, tmp_path):
         with pytest.raises(SystemExit, match="Invalid neighbour name"):
             cli_main(["--data-dir", str(self._data_dir(tmp_path)), "update", "score", "Mallory 1"])
