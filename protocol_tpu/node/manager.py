@@ -38,6 +38,9 @@ class ManagerConfig:
     scale: int = SCALE
     fixed_set: list[tuple[str, str]] = dc_field(default_factory=lambda: list(FIXED_SET))
     backend: str = "native-cpu"
+    #: Run the constraint-system statement check before each proof —
+    #: the reference's always-on MockProver sanity pass.
+    check_circuit: bool = True
 
 
 class Manager:
@@ -82,6 +85,15 @@ class Manager:
             raise EigenError.invalid_attestation("sender not in group")
         sender_hash = self._pk_hash(att.pk)
 
+        # Conservation precondition: the circuit's Σscores == N·IS gate
+        # means a non-SCALE-summing row would poison every future epoch
+        # proof; reject it at the door instead (the reference accepts it
+        # and would panic at proving time, main.rs:170 unwrap).
+        if sum(att.scores) != self.config.scale:
+            raise EigenError.invalid_attestation(
+                f"scores must sum to {self.config.scale}"
+            )
+
         _, message_hashes = calculate_message_hash(att.neighbours, [att.scores])
         if not verify_sig(att.sig, att.pk, message_hashes[0]):
             raise EigenError.invalid_attestation("signature verification failed")
@@ -122,12 +134,27 @@ class Manager:
         """Converge the fixed set exactly and cache a proof of the
         resulting public inputs (manager/mod.rs:170-214)."""
         cfg = self.config
-        ops = self.gather_ops()
+        atts = [self.attestations[h] for h in self._group_hashes]
+        ops = [list(a.scores) for a in atts]
         init = [cfg.initial_score] * cfg.num_neighbours
         pub_ins = power_iterate(init, ops, cfg.num_iter, cfg.scale)
+
+        # Constraint-level statement check before emitting the proof —
+        # the reference runs MockProver::assert_satisfied inside
+        # gen_proof even in release (verifier/mod.rs:62-70).
+        if cfg.check_circuit:
+            from ..zk.circuit import prove_epoch_statement
+
+            prove_epoch_statement(
+                atts,
+                pub_ins,
+                num_neighbours=cfg.num_neighbours,
+                num_iter=cfg.num_iter,
+                initial_score=cfg.initial_score,
+                scale=cfg.scale,
+            )
+
         proof_bytes = self.prover.prove(pub_ins, {"ops": ops})
-        # Debug-parity with the reference's sanity verification
-        # (manager/mod.rs:201-207).
         if __debug__:
             assert self.prover.verify(pub_ins, proof_bytes)
         self.cached_proofs[epoch] = Proof(pub_ins=pub_ins, proof=proof_bytes)

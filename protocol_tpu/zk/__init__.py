@@ -15,4 +15,6 @@ This package rebuilds the proving stack in stages:
   verifications, the I×N×N power iteration, score conservation.
 """
 
+from .circuit import EigenTrustCircuit, prove_epoch_statement  # noqa: F401
+from .cs import ConstraintSystem  # noqa: F401
 from .proof import Proof, ProofRaw, PoseidonCommitmentProver  # noqa: F401

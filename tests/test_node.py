@@ -133,6 +133,14 @@ class TestManager:
         with pytest.raises(EigenError):
             m.add_attestation(att)
 
+    def test_reject_non_conserving_scores(self):
+        """A validly-signed row not summing to SCALE would poison every
+        epoch proof (conservation gate); rejected at ingest."""
+        m = Manager()
+        att = make_attestation(scores=[999, 0, 0, 0, 0])
+        with pytest.raises(EigenError, match="sum"):
+            m.add_attestation(att)
+
     def test_reject_bad_signature(self):
         m = Manager()
         att = make_attestation()

@@ -1,0 +1,346 @@
+"""ZK layer tests, following the reference's MockProver ladder
+(SURVEY.md §4 tier 2-3): every gadget gets a positive and a tampered
+negative check; the full EigenTrust circuit is checked against the
+native kernel's output as its public instance."""
+
+import pytest
+
+from protocol_tpu.crypto import calculate_message_hash, field
+from protocol_tpu.crypto.babyjubjub import B8, Point
+from protocol_tpu.crypto.eddsa import SecretKey, sign
+from protocol_tpu.crypto.poseidon import permute
+from protocol_tpu.node.attestation import Attestation
+from protocol_tpu.node.bootstrap import FIXED_SET, keyset_from_raw
+from protocol_tpu.trust.native import power_iterate
+from protocol_tpu.zk.circuit import EigenTrustCircuit, prove_epoch_statement
+from protocol_tpu.zk.cs import ConstraintSystem
+from protocol_tpu.zk.eddsa import EddsaChipset
+from protocol_tpu.zk.gadgets import (
+    Bits2NumChip,
+    EdwardsChip,
+    LessEqChip,
+    PoseidonChip,
+    PoseidonSpongeChip,
+    SetChip,
+    StdGate,
+)
+
+P = field.MODULUS
+
+
+def fresh():
+    cs = ConstraintSystem()
+    return cs, StdGate(cs)
+
+
+class TestStdGate:
+    def test_add_mul_sub(self):
+        cs, std = fresh()
+        x, y = std.witness(7), std.witness(5)
+        assert std.cell_value(std.add(x, y)) == 12
+        assert std.cell_value(std.sub(x, y)) == 2
+        assert std.cell_value(std.mul(x, y)) == 35
+        assert std.cell_value(std.mul_add(x, y, std.witness(3))) == 38
+        cs.assert_satisfied()
+
+    def test_tampered_mul_fails(self):
+        cs, std = fresh()
+        out = std.mul(std.witness(3), std.witness(4))
+        cs.trace[out.column][out.row] = 13  # lie about the product
+        assert cs.verify()
+
+    def test_is_zero_both_branches(self):
+        cs, std = fresh()
+        assert std.cell_value(std.is_zero(std.witness(0))) == 1
+        assert std.cell_value(std.is_zero(std.witness(9))) == 0
+        cs.assert_satisfied()
+
+    def test_is_equal_select_and(self):
+        cs, std = fresh()
+        t = std.is_equal(std.witness(4), std.witness(4))
+        f = std.is_equal(std.witness(4), std.witness(5))
+        assert std.cell_value(t) == 1 and std.cell_value(f) == 0
+        sel = std.select(t, std.witness(10), std.witness(20))
+        assert std.cell_value(sel) == 10
+        land = std.logical_and(t, f)
+        assert std.cell_value(land) == 0
+        cs.assert_satisfied()
+
+    def test_select_non_boolean_cond_fails(self):
+        cs, std = fresh()
+        std.select(std.witness(2), std.witness(1), std.witness(0))
+        assert cs.verify()  # booleanity violated
+
+    def test_inverse(self):
+        cs, std = fresh()
+        inv = std.inverse(std.witness(1234))
+        assert std.cell_value(inv) == field.inv(1234)
+        cs.assert_satisfied()
+
+    def test_inverse_of_zero_unsatisfiable(self):
+        cs, std = fresh()
+        std.inverse(std.witness(0))
+        assert cs.verify()
+
+    def test_constant_binding(self):
+        cs, std = fresh()
+        c = std.constant(42)
+        assert std.cell_value(c) == 42
+        cs.assert_satisfied()
+        cs.trace[c.column][c.row] = 43
+        assert cs.verify()
+
+
+class TestBits2Num:
+    def test_decompose_and_recompose(self):
+        cs, std = fresh()
+        b2n = Bits2NumChip(cs)
+        bits = b2n.decompose(std.witness(0b1011001), 8)
+        assert [cs.value(b.column, b.row) for b in bits] == [1, 0, 0, 1, 1, 0, 1, 0]
+        cs.assert_satisfied()
+
+    def test_value_too_wide_fails(self):
+        cs, std = fresh()
+        Bits2NumChip(cs).decompose(std.witness(300), 8)
+        assert cs.verify()  # 300 needs 9 bits
+
+    def test_flipped_bit_fails(self):
+        cs, std = fresh()
+        bits = Bits2NumChip(cs).decompose(std.witness(6), 4)
+        cs.trace[bits[0].column][bits[0].row] = 1
+        assert cs.verify()
+
+    def test_adversarial_acc_shift_fails(self):
+        """Soundness: shifting every accumulator cell by a constant and
+        forging the bits must be caught by the init-row constraint."""
+        cs, std = fresh()
+        b2n = Bits2NumChip(cs)
+        bits = b2n.decompose(std.witness(6), 4)
+        delta = (6 - 15) % P
+        first_row = bits[0].row
+        for r in range(first_row, first_row + 4):
+            cs.trace[b2n.bit][r] = 1
+        for r in range(first_row, first_row + 5):
+            cs.trace[b2n.acc][r] = (cs.trace[b2n.acc].get(r, 0) + delta) % P
+        # restore final acc to match the copied value cell
+        cs.trace[b2n.acc][first_row + 4] = 6
+        assert cs.verify(), "forged decomposition must not satisfy"
+
+
+class TestLessEq:
+    def test_le_holds(self):
+        cs, std = fresh()
+        chip = LessEqChip(cs, std, Bits2NumChip(cs))
+        chip.assert_le(std.witness(100), std.witness(200))
+        chip.assert_le(std.witness(200), std.witness(200))
+        cs.assert_satisfied()
+
+    def test_gt_fails(self):
+        cs, std = fresh()
+        LessEqChip(cs, std, Bits2NumChip(cs)).assert_le(
+            std.witness(201), std.witness(200)
+        )
+        assert cs.verify()
+
+    def test_wraparound_operand_fails(self):
+        """Soundness: a near-modulus operand must not pass via mod-P
+        wraparound of the shifted difference."""
+        from protocol_tpu.crypto.babyjubjub import SUBORDER
+
+        cs, std = fresh()
+        LessEqChip(cs, std, Bits2NumChip(cs)).assert_le(
+            std.witness(P - 1), std.witness(SUBORDER)
+        )
+        assert cs.verify(), "P-1 <= SUBORDER must not be satisfiable"
+
+
+class TestSetChip:
+    def test_membership(self):
+        cs, std = fresh()
+        chip = SetChip(std)
+        items = [std.witness(v) for v in (5, 9, 11)]
+        chip.assert_member(std.witness(9), items)
+        assert std.cell_value(chip.is_member(std.witness(11), items)) == 1
+        assert std.cell_value(chip.is_member(std.witness(10), items)) == 0
+        cs.assert_satisfied()
+
+    def test_non_member_assert_fails(self):
+        cs, std = fresh()
+        SetChip(std).assert_member(std.witness(3), [std.witness(1), std.witness(2)])
+        assert cs.verify()
+
+
+class TestPoseidonChip:
+    def test_permute_matches_native(self):
+        cs, std = fresh()
+        chip = PoseidonChip(cs)
+        inputs = [std.witness(v) for v in (0, 1, 2, 3, 4)]
+        out = chip.permute(inputs)
+        native = permute([0, 1, 2, 3, 4])
+        assert [cs.value(c.column, c.row) for c in out] == native
+        cs.assert_satisfied()
+
+    def test_tampered_round_fails(self):
+        cs, std = fresh()
+        chip = PoseidonChip(cs)
+        out = chip.permute([std.witness(v) for v in (0, 1, 2, 3, 4)])
+        cs.trace[out[0].column][out[0].row - 30] += 1  # corrupt a mid round
+        assert cs.verify()
+
+    def test_sponge_matches_native(self):
+        from protocol_tpu.crypto.poseidon import PoseidonSponge
+
+        cs, std = fresh()
+        chip = PoseidonSpongeChip(cs, std, PoseidonChip(cs))
+        values = list(range(1, 11))
+        out = chip.squeeze([std.witness(v) for v in values])
+        native = PoseidonSponge()
+        native.update(values)
+        assert cs.value(out.column, out.row) == native.squeeze()
+        cs.assert_satisfied()
+
+
+class TestEdwardsChip:
+    def test_scalar_mul_matches_native(self):
+        cs, std = fresh()
+        chip = EdwardsChip(cs)
+        k = 0xDEADBEEFCAFE1234567
+        native = B8.mul_scalar(k).affine()
+        one = std.constant(1)
+        out = chip.scalar_mul((std.constant(B8.x), std.constant(B8.y), one), std.witness(k))
+        zinv = field.inv(cs.value(out[2].column, out[2].row))
+        x = field.mul(cs.value(out[0].column, out[0].row), zinv)
+        y = field.mul(cs.value(out[1].column, out[1].row), zinv)
+        assert (x, y) == (native.x, native.y)
+        cs.assert_satisfied()
+
+    def test_scalar_mismatch_fails(self):
+        cs, std = fresh()
+        chip = EdwardsChip(cs)
+        one = std.constant(1)
+        sc = std.witness(99)
+        chip.scalar_mul((std.constant(B8.x), std.constant(B8.y), one), sc)
+        cs.trace[sc.column][sc.row] = 98  # claimed scalar differs from bits
+        assert cs.verify()
+
+    def test_add_points_matches_native(self):
+        cs, std = fresh()
+        chip = EdwardsChip(cs)
+        one = std.constant(1)
+        p1 = B8.mul_scalar(5).affine()
+        p2 = B8.mul_scalar(7).affine()
+        native = p1.projective().add(p2.projective()).affine()
+        out = chip.add_points(
+            (std.constant(p1.x), std.constant(p1.y), one),
+            (std.constant(p2.x), std.constant(p2.y), one),
+        )
+        zinv = field.inv(cs.value(out[2].column, out[2].row))
+        assert field.mul(cs.value(out[0].column, out[0].row), zinv) == native.x
+        assert field.mul(cs.value(out[1].column, out[1].row), zinv) == native.y
+        cs.assert_satisfied()
+
+
+class TestEddsaChipset:
+    def _chipset(self, cs, std):
+        return EddsaChipset(
+            cs, std, EdwardsChip(cs), PoseidonChip(cs), Bits2NumChip(cs)
+        )
+
+    def test_valid_signature(self):
+        cs, std = fresh()
+        sk = SecretKey.random()
+        pk = sk.public()
+        m = 123456789
+        sig = sign(sk, pk, m)
+        self._chipset(cs, std).verify(
+            (std.witness(pk.point.x), std.witness(pk.point.y)),
+            (std.witness(sig.big_r.x), std.witness(sig.big_r.y)),
+            std.witness(sig.s),
+            std.witness(m),
+        )
+        cs.assert_satisfied()
+
+    def test_wrong_message_fails(self):
+        cs, std = fresh()
+        sk = SecretKey.random()
+        pk = sk.public()
+        sig = sign(sk, pk, 111)
+        self._chipset(cs, std).verify(
+            (std.witness(pk.point.x), std.witness(pk.point.y)),
+            (std.witness(sig.big_r.x), std.witness(sig.big_r.y)),
+            std.witness(sig.s),
+            std.witness(222),
+        )
+        assert cs.verify()
+
+    def test_oversized_s_fails(self):
+        from protocol_tpu.crypto.babyjubjub import SUBORDER
+
+        cs, std = fresh()
+        sk = SecretKey.random()
+        pk = sk.public()
+        sig = sign(sk, pk, 5)
+        self._chipset(cs, std).verify(
+            (std.witness(pk.point.x), std.witness(pk.point.y)),
+            (std.witness(sig.big_r.x), std.witness(sig.big_r.y)),
+            std.witness(sig.s + SUBORDER + 1),
+            std.witness(5),
+        )
+        assert cs.verify()
+
+
+def build_attestations(scores_rows):
+    sks, pks = keyset_from_raw(FIXED_SET)
+    _, messages = calculate_message_hash(pks, scores_rows)
+    atts = []
+    for sk, pk, msg, row in zip(sks, pks, messages, scores_rows):
+        atts.append(
+            Attestation(sig=sign(sk, pk, msg), pk=pk, neighbours=list(pks), scores=row)
+        )
+    return atts
+
+
+class TestEigenTrustCircuit:
+    def test_full_circuit_against_native(self):
+        """The tier-3 check (circuit.rs:488-554): the native kernel's
+        output is the satisfied circuit's public instance."""
+        scores_rows = [[200] * 5 for _ in range(5)]
+        atts = build_attestations(scores_rows)
+        pub = power_iterate([1000] * 5, scores_rows, 10, 1000)
+        cs = prove_epoch_statement(atts, pub)
+        stats = cs.stats()
+        assert stats["rows"] > 5000  # non-trivial statement
+
+    def test_wrong_instance_fails(self):
+        scores_rows = [[200] * 5 for _ in range(5)]
+        atts = build_attestations(scores_rows)
+        pub = power_iterate([1000] * 5, scores_rows, 10, 1000)
+        pub[0] = field.add(pub[0], 1)
+        with pytest.raises(AssertionError, match="not satisfied"):
+            prove_epoch_statement(atts, pub)
+
+    def test_tampered_ops_fails_signature(self):
+        """Changing a score after signing breaks the message hash →
+        EdDSA constraints fail."""
+        scores_rows = [[200] * 5 for _ in range(5)]
+        atts = build_attestations(scores_rows)
+        atts[0].scores[1] = 300
+        atts[0].scores[2] = 100  # keep row sum for the trust math
+        pub = power_iterate(
+            [1000] * 5, [a.scores for a in atts], 10, 1000
+        )
+        with pytest.raises(AssertionError, match="not satisfied"):
+            prove_epoch_statement(atts, pub)
+
+    def test_heterogeneous_scores(self):
+        scores_rows = [
+            [0, 300, 100, 300, 300],
+            [200, 0, 300, 200, 300],
+            [500, 100, 0, 300, 100],
+            [300, 300, 300, 0, 100],
+            [250, 250, 250, 250, 0],
+        ]
+        atts = build_attestations(scores_rows)
+        pub = power_iterate([1000] * 5, scores_rows, 10, 1000)
+        cs = prove_epoch_statement(atts, pub)
+        assert not cs.verify()
