@@ -1,0 +1,347 @@
+// protocol_native: C++ crypto runtime for the attestation ingest path.
+//
+// The reference's node is native Rust end-to-end; here the Python node
+// delegates its hot loops — batch EdDSA verification and batch Poseidon
+// public-key hashing (one verify + N+2 hashes per ingested attestation,
+// server/src/manager/mod.rs:95-138) — to this library via ctypes.
+//
+// Field arithmetic: Bn254 Fr in Montgomery form, 4x64-bit limbs, CIOS
+// multiplication with __uint128_t.  Constants are generated from the
+// golden-vector-validated Python layer (tools/gen_native_constants.py).
+//
+// Build: make -C native   (produces libprotocol_native.so)
+
+#include "constants.h"
+
+#include <cstdint>
+#include <cstring>
+
+typedef unsigned __int128 u128;
+
+struct Fr {
+    uint64_t l[4];
+};
+
+static inline bool geq_p(const uint64_t a[4]) {
+    for (int i = 3; i >= 0; --i) {
+        if (a[i] != FR_P[i]) return a[i] > FR_P[i];
+    }
+    return true;  // equal
+}
+
+static inline void sub_p(uint64_t a[4]) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; ++i) {
+        u128 d = (u128)a[i] - FR_P[i] - borrow;
+        a[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+static inline void fr_add(Fr &out, const Fr &a, const Fr &b) {
+    u128 carry = 0;
+    for (int i = 0; i < 4; ++i) {
+        u128 s = (u128)a.l[i] + b.l[i] + carry;
+        out.l[i] = (uint64_t)s;
+        carry = s >> 64;
+    }
+    if (carry || geq_p(out.l)) sub_p(out.l);
+}
+
+static inline void fr_sub(Fr &out, const Fr &a, const Fr &b) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; ++i) {
+        u128 d = (u128)a.l[i] - b.l[i] - borrow;
+        out.l[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    if (borrow) {
+        u128 carry = 0;
+        for (int i = 0; i < 4; ++i) {
+            u128 s = (u128)out.l[i] + FR_P[i] + carry;
+            out.l[i] = (uint64_t)s;
+            carry = s >> 64;
+        }
+    }
+}
+
+// Montgomery CIOS multiplication: out = a * b * R^-1 mod p.
+static void fr_mul(Fr &out, const Fr &a, const Fr &b) {
+    uint64_t t[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; ++i) {
+        // t += a[i] * b
+        u128 carry = 0;
+        for (int j = 0; j < 4; ++j) {
+            u128 cur = (u128)t[j] + (u128)a.l[i] * b.l[j] + carry;
+            t[j] = (uint64_t)cur;
+            carry = cur >> 64;
+        }
+        u128 cur = (u128)t[4] + carry;
+        t[4] = (uint64_t)cur;
+        t[5] = (uint64_t)(cur >> 64);
+
+        // m = t[0] * p' mod 2^64;  t += m * p;  t >>= 64
+        uint64_t m = t[0] * FR_P_INV_NEG;
+        carry = ((u128)t[0] + (u128)m * FR_P[0]) >> 64;
+        for (int j = 1; j < 4; ++j) {
+            u128 c2 = (u128)t[j] + (u128)m * FR_P[j] + carry;
+            t[j - 1] = (uint64_t)c2;
+            carry = c2 >> 64;
+        }
+        cur = (u128)t[4] + carry;
+        t[3] = (uint64_t)cur;
+        t[4] = t[5] + (uint64_t)(cur >> 64);
+        t[5] = 0;
+    }
+    memcpy(out.l, t, 32);
+    if (t[4] || geq_p(out.l)) sub_p(out.l);
+}
+
+static inline void fr_sqr(Fr &out, const Fr &a) { fr_mul(out, a, a); }
+
+static const Fr FR_ZERO = {{0, 0, 0, 0}};
+
+static inline void fr_set(Fr &out, const uint64_t v[4]) { memcpy(out.l, v, 32); }
+
+static inline bool fr_is_zero(const Fr &a) {
+    return !(a.l[0] | a.l[1] | a.l[2] | a.l[3]);
+}
+
+static inline bool fr_eq(const Fr &a, const Fr &b) {
+    return !memcmp(a.l, b.l, 32);
+}
+
+// canonical (non-Montgomery) comparison a <= b
+static inline bool limbs_le(const uint64_t a[4], const uint64_t b[4]) {
+    for (int i = 3; i >= 0; --i) {
+        if (a[i] != b[i]) return a[i] < b[i];
+    }
+    return true;
+}
+
+static void fr_to_mont(Fr &out, const uint64_t canon[4]) {
+    Fr a, r2;
+    fr_set(a, canon);
+    fr_set(r2, FR_R2);
+    fr_mul(out, a, r2);
+}
+
+static void fr_from_mont(uint64_t canon[4], const Fr &a) {
+    Fr one = {{1, 0, 0, 0}};
+    Fr res;
+    fr_mul(res, a, one);
+    memcpy(canon, res.l, 32);
+}
+
+// ---------------------------------------------------------------------
+// Poseidon 5x5 (Hades), Montgomery domain.
+
+static inline void sbox5(Fr &x) {
+    Fr x2, x4;
+    fr_sqr(x2, x);
+    fr_sqr(x4, x2);
+    fr_mul(x, x4, x);
+}
+
+static void poseidon_permute(Fr state[5]) {
+    const int half_full = POSEIDON_FULL_ROUNDS / 2;
+    const int total = POSEIDON_FULL_ROUNDS + POSEIDON_PARTIAL_ROUNDS;
+    Fr rc, next[5], prod;
+    int idx = 0;
+    for (int round = 0; round < total; ++round) {
+        bool full = round < half_full || round >= half_full + POSEIDON_PARTIAL_ROUNDS;
+        for (int j = 0; j < 5; ++j) {
+            fr_set(rc, POSEIDON_RC_MONT[idx + j]);
+            fr_add(state[j], state[j], rc);
+        }
+        idx += 5;
+        if (full) {
+            for (int j = 0; j < 5; ++j) sbox5(state[j]);
+        } else {
+            sbox5(state[0]);
+        }
+        for (int i = 0; i < 5; ++i) {
+            next[i] = FR_ZERO;
+            for (int j = 0; j < 5; ++j) {
+                Fr mij;
+                fr_set(mij, POSEIDON_MDS_MONT[i][j]);
+                fr_mul(prod, mij, state[j]);
+                fr_add(next[i], next[i], prod);
+            }
+        }
+        memcpy(state, next, sizeof(next));
+    }
+}
+
+// ---------------------------------------------------------------------
+// BabyJubJub projective arithmetic, Montgomery domain.
+
+struct Pt {
+    Fr x, y, z;
+};
+
+static void pt_double(Pt &out, const Pt &p) {
+    // dbl-2008-bbjlp
+    Fr b, c, d, e, f, h, j, t, ca;
+    fr_add(t, p.x, p.y);
+    fr_sqr(b, t);
+    fr_sqr(c, p.x);
+    fr_sqr(d, p.y);
+    Fr a_const;
+    fr_set(a_const, BJJ_A_MONT);
+    fr_mul(e, a_const, c);
+    fr_add(f, e, d);
+    fr_sqr(h, p.z);
+    fr_add(t, h, h);
+    fr_sub(j, f, t);
+    fr_sub(t, b, c);
+    fr_sub(t, t, d);
+    fr_mul(out.x, t, j);
+    fr_sub(ca, e, d);
+    fr_mul(out.y, f, ca);
+    fr_mul(out.z, f, j);
+}
+
+static void pt_add(Pt &out, const Pt &p, const Pt &q) {
+    // add-2008-bbjlp
+    Fr a, b, c, d, e, f, g, t, u, v;
+    fr_mul(a, p.z, q.z);
+    fr_sqr(b, a);
+    fr_mul(c, p.x, q.x);
+    fr_mul(d, p.y, q.y);
+    Fr d_const, a_const;
+    fr_set(d_const, BJJ_D_MONT);
+    fr_set(a_const, BJJ_A_MONT);
+    fr_mul(e, d_const, c);
+    fr_mul(e, e, d);
+    fr_sub(f, b, e);
+    fr_add(g, b, e);
+    fr_add(t, p.x, p.y);
+    fr_add(u, q.x, q.y);
+    fr_mul(v, t, u);
+    fr_sub(v, v, c);
+    fr_sub(v, v, d);
+    fr_mul(t, a, f);
+    fr_mul(out.x, t, v);
+    fr_mul(t, a_const, c);
+    fr_sub(t, d, t);
+    fr_mul(u, a, g);
+    fr_mul(out.y, u, t);
+    fr_mul(out.z, f, g);
+}
+
+// scalar is canonical 4x64 limbs; LSB-first double-and-add over 256 bits
+// (edwards/native.rs:74-87 semantics).
+static void pt_mul_scalar(Pt &out, const Pt &base, const uint64_t scalar[4]) {
+    Pt r, e;
+    r.x = FR_ZERO;
+    fr_set(r.y, FR_ONE_MONT);
+    fr_set(r.z, FR_ONE_MONT);
+    e = base;
+    Pt tmp;
+    for (int i = 0; i < 256; ++i) {
+        if ((scalar[i / 64] >> (i % 64)) & 1) {
+            pt_add(tmp, r, e);
+            r = tmp;
+        }
+        pt_double(tmp, e);
+        e = tmp;
+    }
+    out = r;
+}
+
+// projective equality: x1*z2 == x2*z1 && y1*z2 == y2*z1
+static bool pt_eq_affine(const Pt &p, const Pt &q) {
+    Fr a, b;
+    fr_mul(a, p.x, q.z);
+    fr_mul(b, q.x, p.z);
+    if (!fr_eq(a, b)) return false;
+    fr_mul(a, p.y, q.z);
+    fr_mul(b, q.y, p.z);
+    return fr_eq(a, b);
+}
+
+// ---------------------------------------------------------------------
+// Exported batch API.  All field inputs/outputs are canonical 4x64-limb
+// little-endian arrays (matching Fr::to_bytes layout as u64 views).
+
+extern "C" {
+
+// Batch width-5 Poseidon: inputs (n, 5, 4) u64 canonical; outputs the
+// full final state (n, 5, 4).
+void poseidon5_permute_batch(const uint64_t *inputs, uint64_t *outputs, int64_t n) {
+#pragma omp parallel for schedule(static)
+    for (int64_t k = 0; k < n; ++k) {
+        Fr state[5];
+        for (int j = 0; j < 5; ++j) fr_to_mont(state[j], inputs + (k * 5 + j) * 4);
+        poseidon_permute(state);
+        for (int j = 0; j < 5; ++j) fr_from_mont(outputs + (k * 5 + j) * 4, state[j]);
+    }
+}
+
+// Batch pk-hash: Poseidon(x, y, 0, 0, 0)[0]  (manager/mod.rs:101-120).
+void pk_hash_batch(const uint64_t *xs, const uint64_t *ys, uint64_t *out, int64_t n) {
+#pragma omp parallel for schedule(static)
+    for (int64_t k = 0; k < n; ++k) {
+        Fr state[5];
+        fr_to_mont(state[0], xs + k * 4);
+        fr_to_mont(state[1], ys + k * 4);
+        state[2] = FR_ZERO;
+        state[3] = FR_ZERO;
+        state[4] = FR_ZERO;
+        poseidon_permute(state);
+        fr_from_mont(out + k * 4, state[0]);
+    }
+}
+
+// Batch EdDSA verification (eddsa/native.rs:130-147): arrays of
+// canonical limbs; writes 1/0 per signature.
+void eddsa_verify_batch(const uint64_t *rx, const uint64_t *ry, const uint64_t *s,
+                        const uint64_t *pkx, const uint64_t *pky,
+                        const uint64_t *msg, uint8_t *ok, int64_t n) {
+    Pt b8;
+    fr_set(b8.x, BJJ_B8_X_MONT);
+    fr_set(b8.y, BJJ_B8_Y_MONT);
+    fr_set(b8.z, FR_ONE_MONT);
+
+#pragma omp parallel for schedule(dynamic, 16)
+    for (int64_t k = 0; k < n; ++k) {
+        const uint64_t *sk = s + k * 4;
+        if (!limbs_le(sk, BJJ_SUBORDER)) {  // s > suborder -> reject
+            ok[k] = 0;
+            continue;
+        }
+        // Cl = B8 * s
+        Pt cl;
+        pt_mul_scalar(cl, b8, sk);
+
+        // m_hash = Poseidon(R.x, R.y, pk.x, pk.y, m)
+        Fr state[5];
+        fr_to_mont(state[0], rx + k * 4);
+        fr_to_mont(state[1], ry + k * 4);
+        fr_to_mont(state[2], pkx + k * 4);
+        fr_to_mont(state[3], pky + k * 4);
+        fr_to_mont(state[4], msg + k * 4);
+        poseidon_permute(state);
+        uint64_t m_hash_canon[4];
+        fr_from_mont(m_hash_canon, state[0]);
+
+        // pk_h = PK * m_hash;  Cr = R + pk_h
+        Pt pk, pk_h, r, cr;
+        fr_to_mont(pk.x, pkx + k * 4);
+        fr_to_mont(pk.y, pky + k * 4);
+        fr_set(pk.z, FR_ONE_MONT);
+        pt_mul_scalar(pk_h, pk, m_hash_canon);
+        fr_to_mont(r.x, rx + k * 4);
+        fr_to_mont(r.y, ry + k * 4);
+        fr_set(r.z, FR_ONE_MONT);
+        pt_add(cr, r, pk_h);
+
+        ok[k] = pt_eq_affine(cr, cl) ? 1 : 0;
+    }
+}
+
+// Library self-check hook (parity with Python golden vectors is tested
+// from pytest).
+int64_t protocol_native_abi_version() { return 1; }
+}

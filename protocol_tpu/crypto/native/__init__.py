@@ -1,0 +1,119 @@
+"""ctypes bindings for the C++ crypto runtime (native/).
+
+Exposes batch Poseidon / pk-hash / EdDSA verification backed by
+libprotocol_native.so; builds it on demand with ``make -C native`` when
+a compiler is available.  ``available()`` gates use — every caller has a
+pure-Python fallback, and parity tests assert bit-identical results.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parents[3] / "native"
+_LIB_PATH = _NATIVE_DIR / "libprotocol_native.so"
+#: None = untried, False = load/build failed (negative cache so a
+#: compiler-less host doesn't re-spawn make per call), else the CDLL.
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is False:
+        raise OSError("native library unavailable (previous build failed)")
+    if _lib is not None:
+        return _lib
+    if not _LIB_PATH.exists():
+        try:
+            build()
+        except Exception:
+            _lib = False
+            raise
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.poseidon5_permute_batch.argtypes = [u64p, u64p, ctypes.c_int64]
+    lib.pk_hash_batch.argtypes = [u64p, u64p, u64p, ctypes.c_int64]
+    lib.eddsa_verify_batch.argtypes = [u64p] * 6 + [u8p, ctypes.c_int64]
+    lib.protocol_native_abi_version.restype = ctypes.c_int64
+    assert lib.protocol_native_abi_version() == 1
+    _lib = lib
+    return lib
+
+
+def build() -> None:
+    subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True, capture_output=True)
+
+
+def available() -> bool:
+    global _lib
+    try:
+        _load()
+        return True
+    except (OSError, subprocess.CalledProcessError, AssertionError):
+        _lib = False
+        return False
+
+
+def _to_limbs(values: list[int]) -> np.ndarray:
+    """ints -> (n, 4) u64 canonical little-endian limb array."""
+    out = np.empty((len(values), 4), dtype=np.uint64)
+    mask = (1 << 64) - 1
+    for i, v in enumerate(values):
+        out[i, 0] = v & mask
+        out[i, 1] = (v >> 64) & mask
+        out[i, 2] = (v >> 128) & mask
+        out[i, 3] = (v >> 192) & mask
+    return out
+
+
+def _from_limbs(arr: np.ndarray) -> list[int]:
+    arr = arr.astype(object)
+    return [
+        int(row[0]) | int(row[1]) << 64 | int(row[2]) << 128 | int(row[3]) << 192
+        for row in arr
+    ]
+
+
+def _ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def poseidon_permute_batch(inputs: list[list[int]]) -> list[list[int]]:
+    """Batch width-5 permutations; bit-identical to
+    crypto.poseidon.permute."""
+    lib = _load()
+    n = len(inputs)
+    flat = _to_limbs([x for row in inputs for x in row])
+    out = np.empty((n * 5, 4), dtype=np.uint64)
+    lib.poseidon5_permute_batch(_ptr(flat), _ptr(out), n)
+    values = _from_limbs(out)
+    return [values[i * 5 : (i + 1) * 5] for i in range(n)]
+
+
+def pk_hash_batch(xs: list[int], ys: list[int]) -> list[int]:
+    """Batch Poseidon(x, y, 0, 0, 0)[0]."""
+    lib = _load()
+    n = len(xs)
+    xs_l, ys_l = _to_limbs(xs), _to_limbs(ys)
+    out = np.empty((n, 4), dtype=np.uint64)
+    lib.pk_hash_batch(_ptr(xs_l), _ptr(ys_l), _ptr(out), n)
+    return _from_limbs(out)
+
+
+def eddsa_verify_batch(
+    rx: list[int], ry: list[int], s: list[int], pkx: list[int], pky: list[int], msg: list[int]
+) -> np.ndarray:
+    """Batch signature verification; returns a bool array."""
+    lib = _load()
+    n = len(rx)
+    arrs = [_to_limbs(v) for v in (rx, ry, s, pkx, pky, msg)]
+    ok = np.zeros(n, dtype=np.uint8)
+    lib.eddsa_verify_batch(
+        *(_ptr(a) for a in arrs), ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n
+    )
+    return ok.astype(bool)

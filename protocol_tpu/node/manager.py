@@ -70,35 +70,89 @@ class Manager:
 
     # -- ingest ---------------------------------------------------------
 
-    def add_attestation(self, att: Attestation) -> None:
-        """Validate and cache one attestation (manager/mod.rs:95-138):
-        the neighbour list must hash-equal the group, the sender must be
-        a member, and the signature must verify over the protocol
-        message hash."""
+    def _structural_error(self, att: Attestation) -> str | None:
+        """The cheap pre-signature checks, shared by both ingest paths
+        (manager/mod.rs:95-138 semantics plus score conservation).
+        Returns a reason or None."""
         # Direct pk comparison is equivalent to the reference's
         # hash-list equality (Poseidon is injective on valid points) and
         # avoids N permutations per ingest.
         if att.neighbours != self._group_pks:
-            raise EigenError.invalid_attestation("neighbour group mismatch")
-
+            return "neighbour group mismatch"
         if att.pk not in self._group_pks:
-            raise EigenError.invalid_attestation("sender not in group")
-        sender_hash = self._pk_hash(att.pk)
-
+            return "sender not in group"
         # Conservation precondition: the circuit's Σscores == N·IS gate
         # means a non-SCALE-summing row would poison every future epoch
         # proof; reject it at the door instead (the reference accepts it
         # and would panic at proving time, main.rs:170 unwrap).
         if sum(att.scores) != self.config.scale:
-            raise EigenError.invalid_attestation(
-                f"scores must sum to {self.config.scale}"
-            )
+            return f"scores must sum to {self.config.scale}"
+        return None
+
+    def add_attestation(self, att: Attestation) -> None:
+        """Validate and cache one attestation (manager/mod.rs:95-138):
+        the neighbour list must match the group, the sender must be a
+        member, and the signature must verify over the protocol message
+        hash."""
+        reason = self._structural_error(att)
+        if reason is not None:
+            raise EigenError.invalid_attestation(reason)
 
         _, message_hashes = calculate_message_hash(att.neighbours, [att.scores])
-        if not verify_sig(att.sig, att.pk, message_hashes[0]):
+        if not self._verify_sig(att, message_hashes[0]):
             raise EigenError.invalid_attestation("signature verification failed")
 
-        self.attestations[sender_hash] = att
+        self.attestations[self._pk_hash(att.pk)] = att
+
+    @staticmethod
+    def _verify_sig(att: Attestation, message_hash: int) -> bool:
+        """EdDSA verification, preferring the C++ runtime."""
+        from ..crypto import native as cnative
+
+        if cnative.available():
+            return bool(
+                cnative.eddsa_verify_batch(
+                    [att.sig.big_r.x],
+                    [att.sig.big_r.y],
+                    [att.sig.s],
+                    [att.pk.point.x],
+                    [att.pk.point.y],
+                    [message_hash],
+                )[0]
+            )
+        return verify_sig(att.sig, att.pk, message_hash)
+
+    def add_attestations_bulk(self, atts: list[Attestation]) -> list[bool]:
+        """High-throughput ingest for event replay: run the shared
+        structural checks per item, then batch the surviving signature
+        verifications through the C++ runtime (one pass instead of A
+        scalar-muls in Python).  Returns per-item acceptance."""
+        from ..crypto import native as cnative
+
+        candidates: list[tuple[int, Attestation, int]] = []
+        accepted = [False] * len(atts)
+        for i, att in enumerate(atts):
+            if self._structural_error(att) is None:
+                _, mh = calculate_message_hash(att.neighbours, [att.scores])
+                candidates.append((i, att, mh[0]))
+
+        if candidates and cnative.available():
+            sig_ok = cnative.eddsa_verify_batch(
+                [a.sig.big_r.x for _, a, _ in candidates],
+                [a.sig.big_r.y for _, a, _ in candidates],
+                [a.sig.s for _, a, _ in candidates],
+                [a.pk.point.x for _, a, _ in candidates],
+                [a.pk.point.y for _, a, _ in candidates],
+                [m for _, _, m in candidates],
+            )
+        else:
+            sig_ok = [verify_sig(a.sig, a.pk, m) for _, a, m in candidates]
+
+        for (i, att, _), ok in zip(candidates, sig_ok):
+            if ok:
+                self.attestations[self._pk_hash(att.pk)] = att
+                accepted[i] = True
+        return accepted
 
     def get_attestation(self, pk: PublicKey) -> Attestation:
         att = self.attestations.get(pk.hash())

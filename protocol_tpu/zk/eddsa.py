@@ -33,23 +33,28 @@ class EddsaChipset:
     ) -> None:
         std = self.std
         one = std.constant(1)
+        lessq = LessEqChip(self.cs, std, self.b2n)
 
         # s ≤ suborder (the reference's lt_eq over the 252-bit suborder).
         suborder = std.constant(SUBORDER)
-        LessEqChip(self.cs, std, self.b2n).assert_le(s, suborder)
+        lessq.assert_le(s, suborder)
 
-        # Cl = B8 · s
+        # Cl = B8 · s.  252 ladder bits: s ≤ suborder < 2^252, and
+        # s + P needs 254 bits, so the bit pattern is forced canonical.
         b8 = (std.constant(B8.x), std.constant(B8.y), one)
-        cl = self.edwards.scalar_mul(b8, s)
+        cl = self.edwards.scalar_mul(b8, s, n_bits=252)
 
         # M = Poseidon(R.x, R.y, PK.x, PK.y, m)
         m_hash = self.poseidon.permute(
             [big_r[0], big_r[1], pk[0], pk[1], message]
         )[0]
 
-        # Cr = R + PK·M
+        # Cr = R + PK·M.  M is a full field element, so the ladder needs
+        # the strict (< P) canonical-bits check.
         pk_proj = (pk[0], pk[1], one)
-        pk_h = self.edwards.scalar_mul(pk_proj, m_hash)
+        pk_h = self.edwards.scalar_mul(
+            pk_proj, m_hash, n_bits=254, strict=True, std=std, lessq=lessq
+        )
         r_proj = (big_r[0], big_r[1], one)
         cr = self.edwards.add_points(r_proj, pk_h)
 

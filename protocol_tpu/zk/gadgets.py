@@ -124,6 +124,21 @@ class StdGate:
         """x² − x = 0 (IsBoolChipset)."""
         self.row({self.a: x, self.b: x}, {"s_ab": 1, "sa": P - 1})
 
+    def add_scaled(self, acc: Cell, x: Cell, k: int) -> Cell:
+        """acc + k·x in one row (k a circuit constant)."""
+        out = (self.cell_value(acc) + k * self.cell_value(x)) % P
+        r = self.row(
+            {self.a: x, self.c: out, self.d: acc},
+            {"sa": k % P, "sc": P - 1, "sd": 1},
+        )
+        return Cell(self.c, r)
+
+    def linear_const(self, x: Cell, k: int, c: int) -> Cell:
+        """k·x + c in one row."""
+        out = (k * self.cell_value(x) + c) % P
+        r = self.row({self.a: x, self.c: out}, {"sa": k % P, "sc": P - 1, "s_const": c % P})
+        return Cell(self.c, r)
+
     def assert_equal(self, x: Cell, y: Cell) -> None:
         self.cs.copy(x, y)
 
@@ -245,6 +260,16 @@ class LessEqChip:
         bits = self.b2n.decompose(z, self.N_SHIFT + 1)
         one = self.std.constant(1)
         self.cs.copy(bits[self.N_SHIFT], one)
+
+    def is_le_const(self, x: Cell, y_const: int, x_bits: int) -> Cell:
+        """Boolean cell: x ≤ y_const, for x range-constrained here to
+        ``x_bits`` (≤ 252) bits and a constant y_const < 2^252."""
+        assert x_bits <= self.N_SHIFT and 0 <= y_const < (1 << self.N_SHIFT)
+        self.b2n.decompose(x, x_bits)
+        # z = y + 2^252 − x; top bit ⇔ x ≤ y.
+        z = self.std.linear_const(x, P - 1, (y_const + (1 << self.N_SHIFT)) % P)
+        bits = self.b2n.decompose(z, self.N_SHIFT + 1)
+        return bits[self.N_SHIFT]
 
 
 class SetChip:
@@ -473,21 +498,36 @@ class EdwardsChip:
         return tuple(self.cs.value(c.column, c.row) for c in pt)
 
     def scalar_mul(
-        self, point: tuple[Cell, Cell, Cell], scalar: Cell, n_bits: int = 256
+        self,
+        point: tuple[Cell, Cell, Cell],
+        scalar: Cell,
+        n_bits: int = 254,
+        strict: bool = False,
+        std: "StdGate | None" = None,
+        lessq: "LessEqChip | None" = None,
     ) -> tuple[Cell, Cell, Cell]:
         """(point · scalar) with the scalar simultaneously re-composed
-        from its bits and copy-constrained to ``scalar``."""
+        from its bits and copy-constrained to ``scalar``.
+
+        The recomposition is mod P, so a bit pattern encoding
+        ``scalar + P`` would satisfy the copy while multiplying by a
+        different integer.  Callers must either bound the scalar below
+        2^n_bits for n_bits ≤ 253 (e.g. the ≤-suborder EdDSA s) or pass
+        ``strict=True``, which splits the bits into low-128/high-126
+        words and constrains the integer value < P (the reference's
+        strict variant, edwards/mod.rs:359-410)."""
         cs = self.cs
         sval = cs.value(scalar.column, scalar.row)
         ex, ey, ez = self._point_values(point)
         start = cs.alloc_rows(n_bits + 1)
+        bit_cells: list[Cell] = []
 
         rx, ry, rz = 0, 1, 1
         acc = 0
         for i in range(n_bits):
             row = start + i
             bit = (sval >> i) & 1
-            cs.assign(self.bit, row, bit)
+            bit_cells.append(cs.assign(self.bit, row, bit))
             cs.assign(self.rx, row, rx)
             cs.assign(self.ry, row, ry)
             cs.assign(self.rz, row, rz)
@@ -518,7 +558,30 @@ class EdwardsChip:
         cs.assign(self.ez, last, ez)
         acc_cell = cs.assign(self.acc, last, acc)
         cs.copy(acc_cell, scalar)
+
+        if strict:
+            assert std is not None and lessq is not None and n_bits == 254
+            self._assert_canonical(bit_cells, std, lessq)
         return (Cell(self.rx, last), Cell(self.ry, last), Cell(self.rz, last))
+
+    def _assert_canonical(
+        self, bit_cells: list[Cell], std: "StdGate", lessq: "LessEqChip"
+    ) -> None:
+        """Constrain the 254-bit pattern to encode an integer < P:
+        value = h·2^128 + l with l the low 128 and h the high 126 bits;
+        value < P ⇔ h < PH ∨ (h = PH ∧ l < PL)."""
+        ph, pl = P >> 128, P & ((1 << 128) - 1)
+        low = std.constant(0)
+        for i in range(128):
+            low = std.add_scaled(low, bit_cells[i], pow(2, i, P))
+        high = std.constant(0)
+        for i in range(128, 254):
+            high = std.add_scaled(high, bit_cells[i], pow(2, i - 128, P))
+        lt_h = lessq.is_le_const(high, ph - 1, 126)
+        eq_h = std.is_equal(high, std.constant(ph))
+        lt_l = lessq.is_le_const(low, pl - 1, 128)
+        ok = std.add(lt_h, std.mul(eq_h, lt_l))
+        std.assert_equal(ok, std.constant(1))
 
     def add_points(
         self, p1: tuple[Cell, Cell, Cell], p2: tuple[Cell, Cell, Cell]

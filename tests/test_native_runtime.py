@@ -1,0 +1,91 @@
+"""C++ runtime parity tests: the native library must be bit-identical
+to the Python crypto layer (the rebuild's native↔python duality,
+SURVEY.md §1)."""
+
+import random
+
+import pytest
+
+from protocol_tpu.crypto import native as cnative
+from protocol_tpu.crypto import field
+from protocol_tpu.crypto.eddsa import SecretKey, sign
+from protocol_tpu.crypto.poseidon import permute
+
+pytestmark = pytest.mark.skipif(
+    not cnative.available(), reason="native library unavailable (no compiler?)"
+)
+
+
+class TestPoseidonParity:
+    def test_batch_matches_python(self):
+        rng = random.Random(11)
+        inputs = [
+            [rng.randrange(field.MODULUS) for _ in range(5)] for _ in range(8)
+        ]
+        outs = cnative.poseidon_permute_batch(inputs)
+        for row, out in zip(inputs, outs):
+            assert out == permute(row)
+
+    def test_edge_values(self):
+        inputs = [[0, 0, 0, 0, 0], [field.MODULUS - 1] * 5, [1, 0, field.MODULUS - 1, 2, 3]]
+        outs = cnative.poseidon_permute_batch(inputs)
+        for row, out in zip(inputs, outs):
+            assert out == permute(row)
+
+    def test_pk_hash_batch(self):
+        sks = [SecretKey.random() for _ in range(4)]
+        pks = [sk.public() for sk in sks]
+        hashes = cnative.pk_hash_batch(
+            [pk.point.x for pk in pks], [pk.point.y for pk in pks]
+        )
+        assert hashes == [pk.hash() for pk in pks]
+
+
+class TestEddsaParity:
+    def test_batch_verify_mixed(self):
+        sks = [SecretKey.random() for _ in range(5)]
+        pks = [sk.public() for sk in sks]
+        msgs = [100 + i for i in range(5)]
+        sigs = [sign(sk, pk, m) for sk, pk, m in zip(sks, pks, msgs)]
+        # Corrupt #1 (message) and #3 (s).
+        msgs_in = list(msgs)
+        msgs_in[1] += 1
+        s_in = [sig.s for sig in sigs]
+        s_in[3] = field.add(s_in[3], 1)
+        ok = cnative.eddsa_verify_batch(
+            [s.big_r.x for s in sigs],
+            [s.big_r.y for s in sigs],
+            s_in,
+            [pk.point.x for pk in pks],
+            [pk.point.y for pk in pks],
+            msgs_in,
+        )
+        assert ok.tolist() == [True, False, True, False, True]
+
+    def test_oversized_s_rejected(self):
+        from protocol_tpu.crypto.babyjubjub import SUBORDER
+
+        sk = SecretKey.random()
+        pk = sk.public()
+        sig = sign(sk, pk, 7)
+        ok = cnative.eddsa_verify_batch(
+            [sig.big_r.x], [sig.big_r.y], [sig.s + SUBORDER + 1],
+            [pk.point.x], [pk.point.y], [7],
+        )
+        assert not ok[0]
+
+
+class TestBulkIngest:
+    def test_bulk_matches_single(self):
+        from protocol_tpu.node.manager import Manager
+        from tests.test_node import make_attestation
+
+        good = make_attestation(0)
+        bad_sig = make_attestation(1)
+        bad_sig.sig = sign(SecretKey.random(), SecretKey.random().public(), 1)
+        bad_sum = make_attestation(2, scores=[1, 0, 0, 0, 0])
+
+        m = Manager()
+        accepted = m.add_attestations_bulk([good, bad_sig, bad_sum])
+        assert accepted == [True, False, False]
+        assert len(m.attestations) == 1

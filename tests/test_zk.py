@@ -223,6 +223,53 @@ class TestEdwardsChip:
         cs.trace[sc.column][sc.row] = 98  # claimed scalar differs from bits
         assert cs.verify()
 
+    def test_scalar_plus_p_aliasing_rejected_in_strict_mode(self):
+        """Soundness: a bit pattern encoding scalar+P recomposes to the
+        same field element but multiplies by a different integer; the
+        strict (< P) check must reject the forged ladder."""
+        from protocol_tpu.zk.gadgets import LessEqChip
+
+        cs, std = fresh()
+        chip = EdwardsChip(cs)
+        b2n = Bits2NumChip(cs)
+        lessq = LessEqChip(cs, std, b2n)
+        one = std.constant(1)
+        k = 12345
+        sc = std.witness(k)
+        out = chip.scalar_mul(
+            (std.constant(B8.x), std.constant(B8.y), one),
+            sc,
+            n_bits=254,
+            strict=True,
+            std=std,
+            lessq=lessq,
+        )
+        cs.assert_satisfied()
+
+        # Forge the whole region as an honest ladder for k+P: rebuild a
+        # second strict scalar_mul whose *witness* value is k+P but whose
+        # copy target claims k.
+        cs2, std2 = fresh()
+        chip2 = EdwardsChip(cs2)
+        b2n2 = Bits2NumChip(cs2)
+        lessq2 = LessEqChip(cs2, std2, b2n2)
+        one2 = std2.constant(1)
+        sc2 = std2.witness(k + P)  # witness() stores the raw int mod P...
+        # emulate the adversary: assign the cell value k (mod P) but run
+        # the ladder over the k+P bit pattern by patching the stored
+        # value before synthesis
+        cs2.trace[sc2.column][sc2.row] = k + P  # un-reduced alias
+        chip2.scalar_mul(
+            (std2.constant(B8.x), std2.constant(B8.y), one2),
+            sc2,
+            n_bits=254,
+            strict=True,
+            std=std2,
+            lessq=lessq2,
+        )
+        cs2.trace[sc2.column][sc2.row] = k  # the claimed canonical scalar
+        assert cs2.verify(), "k+P bit pattern must not satisfy strict mode"
+
     def test_add_points_matches_native(self):
         cs, std = fresh()
         chip = EdwardsChip(cs)
