@@ -1,0 +1,111 @@
+"""Epoch-indexed snapshots of the assembled trust graph and scores.
+
+The reference has no durable node state: the chain is the checkpoint
+and every boot replays events from block 0 (server/src/main.rs:139-143).
+That stance is kept — snapshots are an *optimization*, not a source of
+truth (SURVEY.md §5): at 50M attestations replay is expensive, so the
+node periodically writes the assembled COO graph + the last converged
+score vector and can serve scores immediately after restart while the
+replay catches up.
+
+Format: ``<dir>/epoch_<N>.npz`` (numpy arrays) + ``manifest.json``
+pointing at the latest; writes are atomic (tmp + rename).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from ..trust.graph import TrustGraph
+from .epoch import Epoch
+
+
+@dataclass
+class Snapshot:
+    epoch: Epoch
+    graph: TrustGraph
+    scores: np.ndarray | None
+
+
+class CheckpointStore:
+    def __init__(self, directory: str | Path, keep: int = 4):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.keep = keep
+
+    def _path(self, epoch: Epoch) -> Path:
+        return self.dir / f"epoch_{epoch.number}.npz"
+
+    def _atomic_write(self, dest: Path, write_fn, mode: str) -> None:
+        """tmp + rename with cleanup on failure."""
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, mode) as f:
+                write_fn(f)
+            os.replace(tmp, dest)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def save(self, epoch: Epoch, graph: TrustGraph, scores=None) -> Path:
+        path = self._path(epoch)
+        payload = {
+            "n": np.int64(graph.n),
+            "src": graph.src,
+            "dst": graph.dst,
+            "weight": graph.weight,
+        }
+        if graph.pre_trusted is not None:
+            payload["pre_trusted"] = graph.pre_trusted
+        if scores is not None:
+            payload["scores"] = np.asarray(scores, dtype=np.float64)
+
+        self._atomic_write(path, lambda f: np.savez_compressed(f, **payload), "wb")
+        self._atomic_write(
+            self.dir / "manifest.json",
+            lambda f: json.dump({"latest_epoch": epoch.number}, f),
+            "w",
+        )
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        snaps = sorted(self.epochs())
+        for number in snaps[: -self.keep]:
+            self._path(Epoch(number)).unlink(missing_ok=True)
+
+    def epochs(self) -> list[int]:
+        return [
+            int(p.stem.removeprefix("epoch_"))
+            for p in self.dir.glob("epoch_*.npz")
+        ]
+
+    def load(self, epoch: Epoch) -> Snapshot:
+        with np.load(self._path(epoch)) as z:
+            graph = TrustGraph(
+                n=int(z["n"]),
+                src=z["src"],
+                dst=z["dst"],
+                weight=z["weight"],
+                pre_trusted=z["pre_trusted"] if "pre_trusted" in z else None,
+            )
+            scores = np.array(z["scores"]) if "scores" in z else None
+        return Snapshot(epoch=epoch, graph=graph, scores=scores)
+
+    def load_latest(self) -> Snapshot | None:
+        manifest = self.dir / "manifest.json"
+        if manifest.exists():
+            number = json.loads(manifest.read_text()).get("latest_epoch")
+            if number is not None and self._path(Epoch(number)).exists():
+                return self.load(Epoch(number))
+        epochs = self.epochs()
+        if not epochs:
+            return None
+        return self.load(Epoch(max(epochs)))

@@ -20,6 +20,7 @@ class ProtocolConfig:
     # Rebuild-specific (absent from reference configs; defaulted).
     trust_backend: str = "native-cpu"
     event_fixture: str | None = None
+    checkpoint_dir: str | None = None
 
     @property
     def host(self) -> str:
@@ -41,6 +42,7 @@ class ProtocolConfig:
         cfg.as_contract_address = obj.get("as_contract_address", cfg.as_contract_address)
         cfg.trust_backend = obj.get("trust_backend", cfg.trust_backend)
         cfg.event_fixture = obj.get("event_fixture", cfg.event_fixture)
+        cfg.checkpoint_dir = obj.get("checkpoint_dir", cfg.checkpoint_dir)
         return cfg
 
     @classmethod

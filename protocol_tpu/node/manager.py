@@ -53,6 +53,8 @@ class Manager:
         self.cached_proofs: dict[Epoch, Proof] = {}
         self.attestations: dict[int, Attestation] = {}
         self.cached_results: dict[Epoch, ConvergenceResult] = {}
+        #: The graph the most recent converge_epoch ran on.
+        self.last_graph: TrustGraph | None = None
         _, self._group_pks = keyset_from_raw(self.config.fixed_set)
         self._group_hashes = [pk.hash() for pk in self._group_pks]
         # Poseidon pk-hash memo: hashing is 68 field-level rounds of
@@ -217,11 +219,14 @@ class Manager:
         self, epoch: Epoch, *, alpha: float = 0.0, tol: float = 1e-6, max_iter: int = 50
     ) -> ConvergenceResult:
         """Scaled path: build the open trust graph from every cached
-        attestation and converge it on the configured TrustBackend."""
+        attestation and converge it on the configured TrustBackend.
+        The graph used is kept as ``last_graph`` so checkpointing can
+        persist exactly the graph the scores belong to."""
         graph = self.build_graph()
         result = get_backend(self.config.backend).converge(
             graph, alpha=alpha, tol=tol, max_iter=max_iter
         )
+        self.last_graph = graph
         self.cached_results[epoch] = result
         return result
 
@@ -240,7 +245,9 @@ class Manager:
             peer_id(h)
 
         src, dst, w = [], [], []
-        for sender_hash, att in self.attestations.items():
+        # list() is a GIL-atomic copy: the asyncio ingest thread may be
+        # inserting while an executor thread assembles the graph.
+        for sender_hash, att in list(self.attestations.items()):
             s_id = peer_id(sender_hash)
             for pk, score in zip(att.neighbours, att.scores):
                 if score == 0 or pk.is_null():

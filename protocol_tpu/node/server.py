@@ -19,6 +19,9 @@ import asyncio
 import logging
 from dataclasses import dataclass, field
 
+import json
+
+from ..utils.telemetry import TELEMETRY
 from .config import ProtocolConfig
 from .epoch import Epoch
 from .errors import EigenError
@@ -35,7 +38,8 @@ _STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found", 500: "Internal 
 
 
 def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
-    """Route one request (main.rs:85-119).  Returns (status, body)."""
+    """Route one request (main.rs:85-119 + the rebuild's observability
+    surface).  Returns (status, body)."""
     if method == "GET" and path == "/score":
         try:
             proof = manager.get_last_proof()
@@ -43,6 +47,17 @@ def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
             log.info("score query failed: %s", e)
             return BAD_REQUEST, "InvalidQuery"
         return 200, proof.to_raw().to_json()
+    if method == "GET" and path == "/status":
+        status = {
+            "attestations": len(manager.attestations),
+            "cached_proofs": len(manager.cached_proofs),
+            "latest_epoch": max(
+                (e.number for e in manager.cached_proofs), default=None
+            ),
+            "backend": manager.config.backend,
+            "telemetry": TELEMETRY.snapshot(),
+        }
+        return 200, json.dumps(status)
     return NOT_FOUND, "InvalidRequest"
 
 
@@ -94,10 +109,15 @@ class Node:
 
     def _epoch_tick(self, epoch: Epoch) -> None:
         """One epoch of work: the fixed-set proof (reference parity) and,
-        on a TPU backend, open-graph convergence at scale."""
-        self.manager.calculate_proofs(epoch)
+        on a TPU backend, open-graph convergence at scale; snapshots the
+        assembled graph + scores when a checkpoint dir is configured."""
+        with TELEMETRY.timer("epoch.calculate_proofs"):
+            self.manager.calculate_proofs(epoch)
+        scores = None
         if self.manager.config.backend != "native-cpu":
-            result = self.manager.converge_epoch(epoch, alpha=0.1)
+            with TELEMETRY.timer("epoch.converge_open_graph"):
+                result = self.manager.converge_epoch(epoch, alpha=0.1)
+            scores = result.scores
             log.info(
                 "epoch %s: open graph n=%d converged in %d iters (resid %.2e) on %s",
                 epoch,
@@ -106,6 +126,16 @@ class Node:
                 result.residual,
                 result.backend,
             )
+        if self.config.checkpoint_dir:
+            from .checkpoint import CheckpointStore
+
+            # Persist exactly the graph the scores were computed on
+            # (ingest keeps mutating the attestation cache concurrently;
+            # a rebuilt graph could have more peers than scores).
+            graph = self.manager.last_graph if scores is not None else self.manager.build_graph()
+            with TELEMETRY.timer("epoch.checkpoint"):
+                CheckpointStore(self.config.checkpoint_dir).save(epoch, graph, scores)
+        TELEMETRY.count("epochs")
 
     async def _epoch_loop(self):
         interval = self.config.epoch_interval

@@ -28,6 +28,107 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _compensated_cumsum(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Inclusive prefix sum in double-single (hi, lo) arithmetic via
+    ``lax.associative_scan`` with a TwoSum combiner.
+
+    A plain f32 cumsum over tens of millions of edges accumulates
+    O(eps·√E) absolute error, which then cancels catastrophically when
+    differencing at row pointers for small rows.  Tracking the rounding
+    error in a second f32 lane recovers ~f64 accuracy while staying in
+    the TPU's fast vector path (device f64 is emulated and slow)."""
+
+    def two_sum(a, b):
+        a_hi, a_lo = a
+        b_hi, b_lo = b
+        s = a_hi + b_hi
+        bb = s - a_hi
+        err = (a_hi - (s - bb)) + (b_hi - bb)
+        return s, a_lo + b_lo + err
+
+    hi, lo = lax.associative_scan(two_sum, (x, jnp.zeros_like(x)))
+    return hi, lo
+
+
+def power_step_csr(
+    src: jax.Array,
+    row_ptr: jax.Array,
+    w: jax.Array,
+    t: jax.Array,
+    p: jax.Array,
+    dangling: jax.Array,
+    alpha: jax.Array | float,
+) -> jax.Array:
+    """One damped step in the gather-only CSR/cumsum formulation.
+
+    TPU scatter (what ``segment_sum`` lowers to) serializes on random
+    destination indices; with dst-sorted edges the per-row sums are
+    differences of a compensated exclusive prefix sum at the row
+    pointers — a scan plus two gathers, all streaming-friendly on the
+    VPU:
+
+        cᵀt[j] = cs[row_ptr[j+1]] − cs[row_ptr[j]],
+        cs = [0, cumsum(w · t[src])].
+    """
+    contrib = w * t[src]
+    hi, lo = _compensated_cumsum(contrib)
+    zero = jnp.zeros(1, contrib.dtype)
+    hi = jnp.concatenate([zero, hi])
+    lo = jnp.concatenate([zero, lo])
+    # Difference hi and lo lanes separately: the hi cancellation is
+    # exact (Sterbenz-adjacent), the tracked error lives in lo.
+    ct = (hi[row_ptr[1:]] - hi[row_ptr[:-1]]) + (lo[row_ptr[1:]] - lo[row_ptr[:-1]])
+    dangling_mass = jnp.sum(t * dangling)
+    t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
+    return t_new / jnp.sum(t_new)
+
+
+def run_power_iteration(step_fn, t0: jax.Array, *, tol: float, max_iter: int):
+    """Shared on-device convergence driver: iterate ``step_fn`` under
+    while_loop until the L1 residual drops below ``tol`` (or fori_loop
+    for exactly ``max_iter`` fixed steps when ``tol <= 0``, the
+    benchmark mode).  Used by every sparse/sharded convergence kernel so
+    early-exit semantics can't drift between formulations."""
+
+    def cond(state):
+        t, prev, it = state
+        resid = jnp.sum(jnp.abs(t - prev))
+        return (it < max_iter) & ((it == 0) | (resid > tol))
+
+    def body(state):
+        t, _, it = state
+        return (step_fn(t), t, it + 1)
+
+    init = (t0, jnp.full_like(t0, jnp.inf), jnp.array(0, jnp.int32))
+    if tol <= 0:
+        t, prev, it = lax.fori_loop(0, max_iter, lambda _, s: body(s), init)
+    else:
+        t, prev, it = lax.while_loop(cond, body, init)
+    return t, it, jnp.sum(jnp.abs(t - prev))
+
+
+@partial(jax.jit, static_argnames=("tol", "max_iter"))
+def converge_csr(
+    src: jax.Array,
+    row_ptr: jax.Array,
+    w: jax.Array,
+    t0: jax.Array,
+    p: jax.Array,
+    dangling: jax.Array,
+    *,
+    alpha: jax.Array | float = 0.1,
+    tol: float = 1e-6,
+    max_iter: int = 50,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """CSR/cumsum analog of ``converge_sparse``."""
+    return run_power_iteration(
+        lambda t: power_step_csr(src, row_ptr, w, t, p, dangling, alpha),
+        t0,
+        tol=tol,
+        max_iter=max_iter,
+    )
+
+
 def power_step_coo(
     src: jax.Array,
     dst: jax.Array,
@@ -71,24 +172,11 @@ def converge_sparse(
     residual)``.  ``tol <= 0`` runs exactly ``max_iter`` steps (the
     benchmarking mode — fixed work, no early exit).  ``alpha`` is a
     traced operand so damping sweeps reuse one compiled kernel."""
-
-    def cond(state):
-        t, prev, it = state
-        resid = jnp.sum(jnp.abs(t - prev))
-        return (it < max_iter) & ((it == 0) | (resid > tol))
-
-    def body(state):
-        t, _, it = state
-        t_new = power_step_coo(
+    return run_power_iteration(
+        lambda t: power_step_coo(
             src, dst, w, t, p, dangling, alpha, n=n, sorted_by_dst=sorted_by_dst
-        )
-        return (t_new, t, it + 1)
-
-    init = (t0, jnp.full_like(t0, jnp.inf), jnp.array(0, jnp.int32))
-    if tol <= 0:
-        t, prev, it = lax.fori_loop(
-            0, max_iter, lambda _, s: body(s), init
-        )
-    else:
-        t, prev, it = lax.while_loop(cond, body, init)
-    return t, it, jnp.sum(jnp.abs(t - prev))
+        ),
+        t0,
+        tol=tol,
+        max_iter=max_iter,
+    )

@@ -110,20 +110,14 @@ def _get_runner(mesh: Mesh, n: int):
 
     @partial(jax.jit, static_argnames=("max_iter", "tol"))
     def run(src, dst, w, t0, p, dangling, alpha, *, max_iter, tol):
-        def body(state):
-            t, _, it = state
-            t_new = step(src, dst, w, t, p, dangling, alpha)
-            return (t_new, t, it + 1)
+        from ..ops.sparse import run_power_iteration
 
-        def cond(state):
-            t, prev, it = state
-            resid = jnp.sum(jnp.abs(t - prev))
-            return (it < max_iter) & ((it == 0) | (resid > tol))
-
-        init = (t0, jnp.full_like(t0, jnp.inf), jnp.array(0, jnp.int32))
-        if tol <= 0:
-            return lax.fori_loop(0, max_iter, lambda _, s: body(s), init)
-        return lax.while_loop(cond, body, init)
+        return run_power_iteration(
+            lambda t: step(src, dst, w, t, p, dangling, alpha),
+            t0,
+            tol=tol,
+            max_iter=max_iter,
+        )
 
     _RUN_CACHE[key] = run
     return run
@@ -142,7 +136,7 @@ def converge_sharded(
     exactly ``max_iter`` fixed steps (benchmark mode).
     """
     run = _get_runner(problem.mesh, problem.n)
-    t, prev, it = run(
+    t, it, resid = run(
         problem.src,
         problem.dst,
         problem.w,
@@ -153,4 +147,4 @@ def converge_sharded(
         max_iter=max_iter,
         tol=tol,
     )
-    return t, int(it), float(jnp.sum(jnp.abs(t - prev)))
+    return t, int(it), float(resid)

@@ -1,12 +1,13 @@
 """TrustBackend — the pluggable execution backend for trust convergence.
 
 The north-star design: the node selects how the epoch's convergence runs
-(BASELINE.json: "native-cpu | tpu-pjrt"), generalized here to four
+(BASELINE.json: "native-cpu | tpu-pjrt"), generalized here to five
 backends along the scaling ladder:
 
 - ``native-cpu``   exact field/rational math (parity with the reference)
 - ``tpu-dense``    jit'd dense matmul power iteration (≤ ~10k peers)
 - ``tpu-sparse``   COO segment-sum SpMV, single device
+- ``tpu-csr``      gather-only CSR/compensated-cumsum SpMV (scatter-free)
 - ``tpu-sharded``  edge-sharded SpMV + psum over a device mesh
 
 All float backends compute the damped EigenTrust fixed point over the
@@ -23,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.dense import converge_dense
-from ..ops.sparse import converge_sparse
+from ..ops.sparse import converge_csr, converge_sparse
 from .graph import TrustGraph
 
 
@@ -175,6 +176,36 @@ class SparseJaxBackend(TrustBackend):
         )
 
 
+class CsrJaxBackend(TrustBackend):
+    """Gather-only CSR/cumsum SpMV — the TPU-friendly formulation
+    (scatter-free; see ops.sparse.power_step_csr)."""
+
+    name = "tpu-csr"
+
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+        g = graph.drop_self_edges()
+        w, dangling = g.row_normalized()
+        g = TrustGraph(g.n, g.src, g.dst, w, graph.pre_trusted).sorted_by_dst()
+        p = graph.pre_trust_vector()
+        t, it, resid = converge_csr(
+            jnp.asarray(g.src),
+            jnp.asarray(g.row_ptr_by_dst()),
+            jnp.asarray(g.weight),
+            jnp.asarray(p),
+            jnp.asarray(p),
+            jnp.asarray(dangling.astype(np.float32)),
+            alpha=jnp.float32(alpha),
+            tol=tol,
+            max_iter=max_iter,
+        )
+        return ConvergenceResult(
+            scores=np.asarray(t, dtype=np.float64),
+            iterations=int(it),
+            residual=float(resid),
+            backend=self.name,
+        )
+
+
 class ShardedJaxBackend(TrustBackend):
     name = "tpu-sharded"
 
@@ -202,6 +233,7 @@ _BACKENDS = {
     "native-cpu": NativeCPUBackend,
     "tpu-dense": DenseJaxBackend,
     "tpu-sparse": SparseJaxBackend,
+    "tpu-csr": CsrJaxBackend,
     "tpu-sharded": ShardedJaxBackend,
 }
 

@@ -94,3 +94,11 @@ class TrustGraph:
         return TrustGraph(
             self.n, self.src[order], self.dst[order], self.weight[order], self.pre_trusted
         )
+
+    def row_ptr_by_dst(self) -> np.ndarray:
+        """CSC-style pointers over dst-sorted edges: ``row_ptr[j] ..
+        row_ptr[j+1]`` is the edge range whose destination is j.  Feeds
+        the cumsum SpMV formulation (gather-only, no scatter)."""
+        return np.searchsorted(self.dst, np.arange(self.n + 1), side="left").astype(
+            np.int32
+        )

@@ -1,0 +1,92 @@
+"""Structured telemetry: named timers/counters and a jax profiler hook.
+
+The reference's observability is bare println (SURVEY.md §5 — proving
+time, gas, kernel dumps); the rebuild makes tracing a subsystem: every
+hot path records into a process-global registry the node exposes over
+``GET /status``, and ``device_trace`` wraps ``jax.profiler.trace`` for
+TPU timeline captures.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TimerStats:
+    count: int = 0
+    total: float = 0.0
+    last: float = 0.0
+    max: float = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        self.last = seconds
+        self.max = max(self.max, seconds)
+
+    def to_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "total_s": round(self.total, 6),
+            "last_s": round(self.last, 6),
+            "max_s": round(self.max, 6),
+            "mean_s": round(self.total / self.count, 6) if self.count else 0.0,
+        }
+
+
+@dataclass
+class Telemetry:
+    """Thread-safe: the node records from executor threads while the
+    event loop snapshots for /status."""
+
+    timers: dict[str, TimerStats] = field(default_factory=lambda: defaultdict(TimerStats))
+    counters: dict[str, int] = field(default_factory=lambda: defaultdict(int))
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - t0
+            with self._lock:
+                self.timers[name].record(elapsed)
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "timers": {k: v.to_dict() for k, v in self.timers.items()},
+                "counters": dict(self.counters),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.timers.clear()
+            self.counters.clear()
+
+
+#: Process-global registry (the node's /status source).
+TELEMETRY = Telemetry()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a TPU timeline with jax.profiler (view with
+    tensorboard/xprof).  No-op context if jax is unavailable."""
+    try:
+        import jax
+    except ImportError:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,87 @@
+"""Auxiliary subsystems: checkpoint/resume, telemetry, /status
+(SURVEY.md §5)."""
+
+import json
+
+import numpy as np
+
+from protocol_tpu.models.graphs import erdos_renyi
+from protocol_tpu.node.checkpoint import CheckpointStore
+from protocol_tpu.node.epoch import Epoch
+from protocol_tpu.node.manager import Manager
+from protocol_tpu.node.server import handle_request
+from protocol_tpu.utils.telemetry import TELEMETRY, Telemetry
+
+
+class TestCheckpointStore:
+    def test_save_load_roundtrip(self, tmp_path):
+        store = CheckpointStore(tmp_path)
+        g = erdos_renyi(100, avg_degree=4.0, seed=1)
+        scores = np.linspace(0, 1, 100)
+        store.save(Epoch(5), g, scores)
+
+        snap = store.load_latest()
+        assert snap.epoch == Epoch(5)
+        assert snap.graph.n == g.n
+        np.testing.assert_array_equal(snap.graph.src, g.src)
+        np.testing.assert_array_equal(snap.graph.weight, g.weight)
+        np.testing.assert_array_equal(snap.graph.pre_trusted, g.pre_trusted)
+        np.testing.assert_allclose(snap.scores, scores)
+
+    def test_latest_tracks_manifest(self, tmp_path):
+        store = CheckpointStore(tmp_path)
+        g = erdos_renyi(20, seed=2)
+        store.save(Epoch(1), g)
+        store.save(Epoch(3), g)
+        store.save(Epoch(2), g)  # out-of-order write
+        assert store.load_latest().epoch == Epoch(2)  # manifest wins
+
+    def test_prune_keeps_recent(self, tmp_path):
+        store = CheckpointStore(tmp_path, keep=2)
+        g = erdos_renyi(10, seed=3)
+        for e in range(5):
+            store.save(Epoch(e), g)
+        assert sorted(store.epochs()) == [3, 4]
+
+    def test_empty_dir(self, tmp_path):
+        assert CheckpointStore(tmp_path).load_latest() is None
+
+    def test_scores_optional(self, tmp_path):
+        store = CheckpointStore(tmp_path)
+        store.save(Epoch(0), erdos_renyi(10, seed=4))
+        assert store.load_latest().scores is None
+
+
+class TestTelemetry:
+    def test_timer_and_counter(self):
+        t = Telemetry()
+        with t.timer("work"):
+            pass
+        with t.timer("work"):
+            pass
+        t.count("items", 3)
+        snap = t.snapshot()
+        assert snap["timers"]["work"]["count"] == 2
+        assert snap["counters"]["items"] == 3
+
+    def test_timer_records_on_exception(self):
+        t = Telemetry()
+        try:
+            with t.timer("boom"):
+                raise RuntimeError
+        except RuntimeError:
+            pass
+        assert t.timers["boom"].count == 1
+
+    def test_status_endpoint(self):
+        TELEMETRY.reset()
+        m = Manager()
+        m.generate_initial_attestations()
+        m.calculate_proofs(Epoch(9))
+        status, body = handle_request("GET", "/status", m)
+        assert status == 200
+        obj = json.loads(body)
+        assert obj["attestations"] == 5
+        assert obj["cached_proofs"] == 1
+        assert obj["latest_epoch"] == 9
+        assert obj["backend"] == "native-cpu"

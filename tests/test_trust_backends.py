@@ -128,6 +128,28 @@ class TestSparseBackend:
         assert res.iterations == 7
 
 
+class TestCsrBackend:
+    def test_matches_sparse_backend(self):
+        g = scale_free(800, 6400, seed=9)
+        sparse = get_backend("tpu-sparse").converge(g, alpha=0.1, tol=1e-9, max_iter=60)
+        csr = get_backend("tpu-csr").converge(g, alpha=0.1, tol=1e-9, max_iter=60)
+        np.testing.assert_allclose(csr.scores, sparse.scores, rtol=1e-3, atol=1e-8)
+
+    def test_matches_exact_native(self):
+        g = erdos_renyi(40, avg_degree=4.0, seed=2)
+        exact = get_backend("native-cpu").converge(g, alpha=0.15, tol=0, max_iter=25)
+        csr = get_backend("tpu-csr").converge(g, alpha=0.15, tol=0, max_iter=25)
+        np.testing.assert_allclose(csr.scores, exact.scores, rtol=1e-3, atol=1e-7)
+
+    def test_row_ptr_construction(self):
+        g = erdos_renyi(50, avg_degree=3.0, seed=12).drop_self_edges().sorted_by_dst()
+        rp = g.row_ptr_by_dst()
+        assert rp.shape == (51,)
+        assert rp[0] == 0 and rp[-1] == g.nnz
+        for j in range(50):
+            assert (g.dst[rp[j] : rp[j + 1]] == j).all()
+
+
 class TestShardedBackend:
     def test_mesh_has_8_devices(self):
         assert len(jax.devices()) == 8  # conftest virtual CPU mesh
