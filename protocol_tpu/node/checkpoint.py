@@ -31,6 +31,7 @@ class Snapshot:
     epoch: Epoch
     graph: TrustGraph
     scores: np.ndarray | None
+    proof_json: str | None = None
 
 
 class CheckpointStore:
@@ -54,7 +55,7 @@ class CheckpointStore:
                 os.unlink(tmp)
             raise
 
-    def save(self, epoch: Epoch, graph: TrustGraph, scores=None) -> Path:
+    def save(self, epoch: Epoch, graph: TrustGraph, scores=None, proof_json: str | None = None) -> Path:
         path = self._path(epoch)
         payload = {
             "n": np.int64(graph.n),
@@ -68,6 +69,12 @@ class CheckpointStore:
             payload["scores"] = np.asarray(scores, dtype=np.float64)
 
         self._atomic_write(path, lambda f: np.savez_compressed(f, **payload), "wb")
+        if proof_json is not None:
+            self._atomic_write(
+                self.dir / f"epoch_{epoch.number}.proof.json",
+                lambda f: f.write(proof_json),
+                "w",
+            )
         self._atomic_write(
             self.dir / "manifest.json",
             lambda f: json.dump({"latest_epoch": epoch.number}, f),
@@ -80,6 +87,7 @@ class CheckpointStore:
         snaps = sorted(self.epochs())
         for number in snaps[: -self.keep]:
             self._path(Epoch(number)).unlink(missing_ok=True)
+            (self.dir / f"epoch_{number}.proof.json").unlink(missing_ok=True)
 
     def epochs(self) -> list[int]:
         return [
@@ -97,7 +105,9 @@ class CheckpointStore:
                 pre_trusted=z["pre_trusted"] if "pre_trusted" in z else None,
             )
             scores = np.array(z["scores"]) if "scores" in z else None
-        return Snapshot(epoch=epoch, graph=graph, scores=scores)
+        proof_path = self.dir / f"epoch_{epoch.number}.proof.json"
+        proof_json = proof_path.read_text() if proof_path.exists() else None
+        return Snapshot(epoch=epoch, graph=graph, scores=scores, proof_json=proof_json)
 
     def load_latest(self) -> Snapshot | None:
         manifest = self.dir / "manifest.json"

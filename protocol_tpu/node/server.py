@@ -133,8 +133,11 @@ class Node:
             # (ingest keeps mutating the attestation cache concurrently;
             # a rebuilt graph could have more peers than scores).
             graph = self.manager.last_graph if scores is not None else self.manager.build_graph()
+            proof_json = self.manager.get_proof(epoch).to_raw().to_json()
             with TELEMETRY.timer("epoch.checkpoint"):
-                CheckpointStore(self.config.checkpoint_dir).save(epoch, graph, scores)
+                CheckpointStore(self.config.checkpoint_dir).save(
+                    epoch, graph, scores, proof_json
+                )
         TELEMETRY.count("epochs")
 
     async def _epoch_loop(self):
@@ -181,7 +184,30 @@ class Node:
             except (EigenError, ValueError) as e:
                 log.warning("rejected attestation event: %s", e)
 
+    def _restore_checkpoint(self) -> None:
+        """Serve the last checkpointed proof immediately after restart;
+        the chain replay (the source of truth, main.rs:139-143) still
+        runs and overwrites as it catches up."""
+        from ..zk.proof import ProofRaw
+        from .checkpoint import CheckpointStore
+
+        snapshot = CheckpointStore(self.config.checkpoint_dir).load_latest()
+        if snapshot is None:
+            return
+        if snapshot.proof_json:
+            proof = ProofRaw.from_json(snapshot.proof_json).to_proof()
+            self.manager.cached_proofs[snapshot.epoch] = proof
+        self.manager.last_graph = snapshot.graph
+        log.info(
+            "restored checkpoint: epoch %s, %d peers%s",
+            snapshot.epoch,
+            snapshot.graph.n,
+            ", proof available" if snapshot.proof_json else "",
+        )
+
     async def start(self) -> None:
+        if self.config.checkpoint_dir:
+            self._restore_checkpoint()
         self.manager.generate_initial_attestations()
         self._server = await asyncio.start_server(
             self._handle_conn, self.config.host, self.config.port

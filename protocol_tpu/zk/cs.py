@@ -76,6 +76,18 @@ class Failure:
     detail: str
 
 
+@dataclass
+class Lookup:
+    """A lookup argument: at every row where ``selector`` is enabled,
+    the tuple of ``columns`` values must be a member of ``table``
+    (Halo2's lookup argument, checked by direct membership here)."""
+
+    name: str
+    selector: str
+    columns: tuple[Column, ...]
+    table: frozenset
+
+
 class ConstraintSystem:
     """Columns + trace + gates + copy constraints."""
 
@@ -84,8 +96,24 @@ class ConstraintSystem:
         self.trace: dict[Column, dict[int, int]] = {}
         self.selectors: dict[str, set[int]] = {}
         self.gates: list[Gate] = []
+        self.lookups: list[Lookup] = []
         self.copies: list[tuple[Cell, Cell]] = []
         self.n_rows = 0
+        self._chips: dict[str, object] = {}
+
+    def register_chip(self, key: str, fingerprint: object = None) -> bool:
+        """One-time chip registration: returns True on first call for
+        ``key``; later calls must carry an identical parameter
+        fingerprint (a second chip instance with different parameters
+        sharing columns/gates would be silently unsound)."""
+        if key not in self._chips:
+            self._chips[key] = fingerprint
+            return True
+        if self._chips[key] != fingerprint:
+            raise AssertionError(
+                f"chip {key!r} re-registered with different parameters"
+            )
+        return False
 
     # -- construction ---------------------------------------------------
 
@@ -103,6 +131,12 @@ class ConstraintSystem:
     def gate(self, name: str, selector: str, poly) -> None:
         self.selectors.setdefault(selector, set())
         self.gates.append(Gate(name, selector, poly))
+
+    def lookup(self, name: str, selector: str, columns, table) -> None:
+        self.selectors.setdefault(selector, set())
+        self.lookups.append(
+            Lookup(name, selector, tuple(columns), frozenset(table))
+        )
 
     def alloc_rows(self, n: int) -> int:
         """Reserve ``n`` fresh rows; returns the first row index."""
@@ -145,6 +179,16 @@ class ConstraintSystem:
                         )
                         if len(failures) >= max_failures:
                             return failures
+        for lookup in self.lookups:
+            for row in sorted(self.selectors.get(lookup.selector, ())):
+                entry = tuple(self.value(c, row) for c in lookup.columns)
+                key = entry[0] if len(entry) == 1 else entry
+                if key not in lookup.table:
+                    failures.append(
+                        Failure(lookup.name, row, f"{key!r} not in lookup table")
+                    )
+                    if len(failures) >= max_failures:
+                        return failures
         for a, b in self.copies:
             va, vb = self.value(a.column, a.row), self.value(b.column, b.row)
             if va != vb:

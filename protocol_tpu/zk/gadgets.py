@@ -302,8 +302,11 @@ class PoseidonChip:
         self.cs = cs
         self.params = params
         w = params.width
-        self.state = [cs.column(f"pos_s{i}") for i in range(w)]
-        self.rc = [cs.column(f"pos_rc{i}", "fixed") for i in range(w)]
+        pre = f"pos{w}"
+        self._sel_full = f"{pre}_full"
+        self._sel_partial = f"{pre}_partial"
+        self.state = [cs.column(f"{pre}_s{i}") for i in range(w)]
+        self.rc = [cs.column(f"{pre}_rc{i}", "fixed") for i in range(w)]
         mds = params.mds
 
         def pow5(x):
@@ -326,15 +329,13 @@ class PoseidonChip:
                 for i in range(w)
             ]
 
-        if not any(g.name == "pos_full" for g in cs.gates):
-            cs.gate("pos_full", "pos_full", full_poly)
-            cs.gate("pos_partial", "pos_partial", partial_poly)
+        if cs.register_chip(pre, (params.round_constants, params.mds)):
+            cs.gate(f"{pre}_full", self._sel_full, full_poly)
+            cs.gate(f"{pre}_partial", self._sel_partial, partial_poly)
 
     def permute(self, inputs: list[Cell]) -> list[Cell]:
         """Allocate the 68 round rows + result row; wires the input
         cells into row 0 and returns the final state cells."""
-        from ..crypto.poseidon import permute as native_permute
-
         cs = self.cs
         params = self.params
         w = params.width
@@ -355,10 +356,10 @@ class PoseidonChip:
             for j in range(w):
                 cs.assign(self.rc[j], row, rc[rnd * w + j])
             if rnd < half_full or rnd >= half_full + params.partial_rounds:
-                cs.enable("pos_full", row)
+                cs.enable(self._sel_full, row)
                 state = [field.pow5((state[j] + rc[rnd * w + j]) % P) for j in range(w)]
             else:
-                cs.enable("pos_partial", row)
+                cs.enable(self._sel_partial, row)
                 state = [(state[j] + rc[rnd * w + j]) % P for j in range(w)]
                 state[0] = field.pow5(state[0])
             state = [
@@ -367,8 +368,6 @@ class PoseidonChip:
             for j in range(w):
                 cs.assign(self.state[j], row + 1, state[j])
 
-        # Cross-check the in-circuit trace against the native permute.
-        assert state == native_permute(values, params)
         return [Cell(self.state[j], start + total_rounds) for j in range(w)]
 
 

@@ -51,6 +51,42 @@ class TestCheckpointStore:
         store.save(Epoch(0), erdos_renyi(10, seed=4))
         assert store.load_latest().scores is None
 
+    def test_node_restores_proof_from_checkpoint(self, tmp_path):
+        """Restart path: a new node serves the checkpointed proof before
+        any epoch has run (SURVEY.md §5 checkpoint/resume doctrine)."""
+        import asyncio
+
+        from protocol_tpu.node.config import ProtocolConfig
+        from protocol_tpu.node.server import Node
+        from protocol_tpu.zk.proof import ProofRaw
+
+        m = Manager()
+        m.generate_initial_attestations()
+        m.calculate_proofs(Epoch(41))
+        store = CheckpointStore(tmp_path)
+        store.save(
+            Epoch(41),
+            m.build_graph(),
+            None,
+            m.get_proof(Epoch(41)).to_raw().to_json(),
+        )
+
+        async def scenario():
+            cfg = ProtocolConfig(
+                epoch_interval=3600,
+                endpoint=((127, 0, 0, 1), 0),
+                checkpoint_dir=str(tmp_path),
+            )
+            node = Node.from_config(cfg)
+            await node.start()
+            status, body = handle_request("GET", "/score", node.manager)
+            await node.stop()
+            return status, body
+
+        status, body = asyncio.run(scenario())
+        assert status == 200
+        assert ProofRaw.from_json(body).to_proof().pub_ins == m.get_proof(Epoch(41)).pub_ins
+
 
 class TestTelemetry:
     def test_timer_and_counter(self):

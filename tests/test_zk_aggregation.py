@@ -1,0 +1,134 @@
+"""Wrong-field RNS integers, Bn254 G1, and the Poseidon transcript
+(parity with circuit/src/integer/, ecc/native.rs and
+verifier/transcript/native.rs test coverage)."""
+
+import random
+
+import pytest
+
+from protocol_tpu.crypto import field
+from protocol_tpu.zk.bn254 import G1, GENERATOR, GROUP_ORDER, IDENTITY, is_on_curve
+from protocol_tpu.zk.rns import (
+    FQ_MODULUS,
+    WrongFieldInteger,
+    compose,
+    decompose,
+)
+from protocol_tpu.zk.transcript import PoseidonRead, PoseidonWrite
+
+rng = random.Random(21)
+
+
+class TestRns:
+    def test_decompose_compose_roundtrip(self):
+        for _ in range(20):
+            v = rng.randrange(FQ_MODULUS)
+            assert compose(decompose(v)) == v
+
+    def test_limb_width(self):
+        limbs = decompose(FQ_MODULUS - 1)
+        assert len(limbs) == 4
+        assert all(limb < 1 << 68 for limb in limbs)
+
+    @pytest.mark.parametrize("op", ["add", "sub", "mul", "div"])
+    def test_reduction_witnesses(self, op):
+        for _ in range(10):
+            a = WrongFieldInteger.from_value(rng.randrange(FQ_MODULUS))
+            b = WrongFieldInteger.from_value(rng.randrange(1, FQ_MODULUS))
+            witness = getattr(a, op)(b)
+            assert witness.check(a, b), op
+            # result matches direct modular arithmetic
+            expect = {
+                "add": (a.value() + b.value()) % FQ_MODULUS,
+                "sub": (a.value() - b.value()) % FQ_MODULUS,
+                "mul": (a.value() * b.value()) % FQ_MODULUS,
+                "div": a.value() * pow(b.value(), -1, FQ_MODULUS) % FQ_MODULUS,
+            }[op]
+            assert witness.result.value() == expect
+
+    def test_forged_witness_rejected(self):
+        a = WrongFieldInteger.from_value(123)
+        b = WrongFieldInteger.from_value(456)
+        w = a.mul(b)
+        forged = type(w)(
+            result=WrongFieldInteger.from_value(w.result.value() + 1),
+            quotient=w.quotient,
+            op="mul",
+        )
+        assert not forged.check(a, b)
+
+    def test_fr_limbs_fit_scalar_field(self):
+        v = WrongFieldInteger.from_value(FQ_MODULUS - 1)
+        assert all(x < field.MODULUS for x in v.to_fr_limbs())
+
+
+class TestBn254G1:
+    def test_generator_on_curve(self):
+        assert is_on_curve(GENERATOR)
+
+    def test_group_order(self):
+        assert GENERATOR.mul(GROUP_ORDER) == IDENTITY
+
+    def test_add_double_consistency(self):
+        p2 = GENERATOR.double()
+        assert p2 == GENERATOR.add(GENERATOR)
+        p3 = p2.add(GENERATOR)
+        assert p3 == GENERATOR.mul(3)
+        assert is_on_curve(p3)
+
+    def test_inverse(self):
+        p = GENERATOR.mul(77)
+        assert p.add(p.neg()) == IDENTITY
+
+    def test_scalar_mul_matches_addition_chain(self):
+        acc = IDENTITY
+        for k in range(8):
+            assert acc == GENERATOR.mul(k)
+            acc = acc.add(GENERATOR)
+
+    def test_identity_laws(self):
+        p = GENERATOR.mul(5)
+        assert IDENTITY.add(p) == p
+        assert p.add(IDENTITY) == p
+        assert IDENTITY.double() == IDENTITY
+
+
+class TestPoseidonTranscript:
+    def test_prover_verifier_challenge_agreement(self):
+        w = PoseidonWrite()
+        p1 = GENERATOR.mul(42)
+        w.write_point(p1)
+        c1_prover = w.squeeze_challenge()
+        w.write_scalar(12345)
+        c2_prover = w.squeeze_challenge()
+        proof = w.finalize()
+
+        r = PoseidonRead(proof)
+        assert r.read_point() == p1
+        assert r.squeeze_challenge() == c1_prover
+        assert r.read_scalar() == 12345
+        assert r.squeeze_challenge() == c2_prover
+
+    def test_transcript_binds_messages(self):
+        w1, w2 = PoseidonWrite(), PoseidonWrite()
+        w1.write_scalar(1)
+        w2.write_scalar(2)
+        assert w1.squeeze_challenge() != w2.squeeze_challenge()
+
+    def test_successive_challenges_differ(self):
+        w = PoseidonWrite()
+        w.write_scalar(9)
+        assert w.squeeze_challenge() != w.squeeze_challenge()
+
+    def test_off_curve_point_rejected(self):
+        w = PoseidonWrite()
+        with pytest.raises(ValueError, match="not on curve"):
+            w.write_point(G1(5, 5))
+
+    def test_truncated_proof_rejected(self):
+        w = PoseidonWrite()
+        w.write_scalar(7)
+        proof = w.finalize()
+        r = PoseidonRead(proof[:16])
+        with pytest.raises(ValueError, match="exhausted"):
+            r.read_scalar()

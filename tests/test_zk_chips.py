@@ -1,0 +1,133 @@
+"""Range-lookup, Merkle-path and Rescue-Prime chip tests (parity with
+gadgets/range.rs, merkle_tree/mod.rs, rescue_prime/mod.rs test
+coverage)."""
+
+from protocol_tpu.crypto import field
+from protocol_tpu.crypto.merkle import MerkleTree, Path
+from protocol_tpu.crypto.poseidon import rescue_prime_permute
+from protocol_tpu.zk.chips import MerklePathChip, RangeCheckChip, RescuePrimeChip
+from protocol_tpu.zk.cs import ConstraintSystem
+from protocol_tpu.zk.gadgets import PoseidonChip, StdGate
+
+P = field.MODULUS
+
+
+def fresh():
+    cs = ConstraintSystem()
+    return cs, StdGate(cs)
+
+
+class TestRangeCheck:
+    def test_word_in_range(self):
+        cs, std = fresh()
+        chip = RangeCheckChip(cs)
+        chip.assert_word(std.witness(255))
+        chip.assert_word(std.witness(0))
+        cs.assert_satisfied()
+
+    def test_word_out_of_range(self):
+        cs, std = fresh()
+        RangeCheckChip(cs).assert_word(std.witness(256))
+        assert cs.verify()
+
+    def test_running_sum_range(self):
+        cs, std = fresh()
+        chip = RangeCheckChip(cs)
+        chip.assert_range(std.witness(0xABCDEF), 3)  # < 2^24
+        cs.assert_satisfied()
+
+    def test_running_sum_too_wide(self):
+        cs, std = fresh()
+        RangeCheckChip(cs).assert_range(std.witness(1 << 24), 3)
+        assert cs.verify()
+
+    def test_two_widths_coexist_soundly(self):
+        """Regression: a second chip with a different word size must get
+        its own table, not silently share the first one's."""
+        cs, std = fresh()
+        RangeCheckChip(cs, word_bits=16).assert_word(std.witness(300))
+        RangeCheckChip(cs, word_bits=8).assert_word(std.witness(300))
+        failures = cs.verify()
+        assert failures and "rng8" in failures[0].gate
+
+    def test_forged_words_fail(self):
+        cs, std = fresh()
+        chip = RangeCheckChip(cs)
+        chip.assert_range(std.witness(77), 2)
+        # Shift every acc cell: init gate must catch it.
+        rows = sorted(cs.selectors["rng8_sum"])
+        for r in rows + [rows[-1] + 1]:
+            cs.trace[chip.acc][r] = (cs.trace[chip.acc].get(r, 0) + 5) % P
+        cs.trace[chip.acc][rows[-1] + 1] = 77
+        assert cs.verify()
+
+
+class TestMerklePathChip:
+    def _tree_and_path(self):
+        leaves = [7, 11, 13, 17, 19, 23, 29, 31]
+        tree = MerkleTree.build(leaves, 3)
+        return tree, Path.find(tree, 13)
+
+    def test_valid_path(self):
+        tree, path = self._tree_and_path()
+        cs, std = fresh()
+        chip = MerklePathChip(cs, std, PoseidonChip(cs))
+        pairs = [
+            (std.witness(left), std.witness(right))
+            for left, right in path.pairs[:-1]
+        ]
+        chip.verify_path(std.witness(13), pairs, std.witness(tree.root))
+        cs.assert_satisfied()
+
+    def test_wrong_value_fails(self):
+        tree, path = self._tree_and_path()
+        cs, std = fresh()
+        chip = MerklePathChip(cs, std, PoseidonChip(cs))
+        pairs = [
+            (std.witness(left), std.witness(right))
+            for left, right in path.pairs[:-1]
+        ]
+        chip.verify_path(std.witness(14), pairs, std.witness(tree.root))
+        assert cs.verify()
+
+    def test_wrong_root_fails(self):
+        tree, path = self._tree_and_path()
+        cs, std = fresh()
+        chip = MerklePathChip(cs, std, PoseidonChip(cs))
+        pairs = [
+            (std.witness(left), std.witness(right))
+            for left, right in path.pairs[:-1]
+        ]
+        chip.verify_path(std.witness(13), pairs, std.witness(tree.root + 1))
+        assert cs.verify()
+
+    def test_tampered_sibling_fails(self):
+        tree, path = self._tree_and_path()
+        cs, std = fresh()
+        chip = MerklePathChip(cs, std, PoseidonChip(cs))
+        tampered = [list(p) for p in path.pairs[:-1]]
+        tampered[1][0] += 1
+        pairs = [
+            (std.witness(left), std.witness(right)) for left, right in tampered
+        ]
+        chip.verify_path(std.witness(13), pairs, std.witness(tree.root))
+        assert cs.verify()
+
+
+class TestRescuePrimeChip:
+    def test_permute_matches_native(self):
+        cs, std = fresh()
+        chip = RescuePrimeChip(cs)
+        inputs = [std.witness(v) for v in (0, 1, 2, 3, 4)]
+        out = chip.permute(inputs)
+        native = rescue_prime_permute([0, 1, 2, 3, 4])
+        assert [cs.value(c.column, c.row) for c in out] == native
+        cs.assert_satisfied()
+
+    def test_tampered_mid_witness_fails(self):
+        cs, std = fresh()
+        chip = RescuePrimeChip(cs)
+        chip.permute([std.witness(v) for v in (5, 6, 7, 8, 9)])
+        rows = sorted(cs.selectors["rp5_round"])
+        cs.trace[chip.mid[2]][rows[3]] += 1
+        assert cs.verify()
