@@ -1,0 +1,688 @@
+// zk_runtime: C++ proving runtime for the KZG/PLONK stack.
+//
+// The reference's prover is halo2's Rust backend (create_proof,
+// circuit/src/utils.rs:259-281): its hot loops are NTTs over Fr,
+// multi-scalar multiplications over G1, and evaluating the combined
+// gate polynomial over an extended coset domain.  This library is the
+// native engine for those three loops; Python (protocol_tpu.zk)
+// orchestrates the protocol and keeps a pure fallback for environments
+// without a compiler.
+//
+// ABI: canonical little-endian 4x64-bit limbs everywhere; G1 points as
+// 8 limbs (x, y affine; (0,0) = identity).  Montgomery conversion is
+// internal.
+//
+// Build: make -C native libzk_runtime.so
+
+#include "constants.h"
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------------
+// Generic 4-limb Montgomery field.
+
+struct FrP {
+    static const uint64_t *mod() { return FR_P; }
+    static const uint64_t *r2() { return FR_R2; }
+    static const uint64_t *one() { return FR_ONE_MONT; }
+    static uint64_t pinv() { return FR_P_INV_NEG; }
+};
+
+struct FqP {
+    static const uint64_t *mod() { return FQ_P; }
+    static const uint64_t *r2() { return FQ_R2; }
+    static const uint64_t *one() { return FQ_ONE_MONT; }
+    static uint64_t pinv() { return FQ_P_INV_NEG; }
+};
+
+template <typename P> struct Fp {
+    uint64_t l[4];
+
+    static inline bool geq_p(const uint64_t a[4]) {
+        const uint64_t *m = P::mod();
+        for (int i = 3; i >= 0; --i) {
+            if (a[i] != m[i]) return a[i] > m[i];
+        }
+        return true;
+    }
+
+    static inline void sub_p(uint64_t a[4]) {
+        const uint64_t *m = P::mod();
+        u128 borrow = 0;
+        for (int i = 0; i < 4; ++i) {
+            u128 d = (u128)a[i] - m[i] - borrow;
+            a[i] = (uint64_t)d;
+            borrow = (d >> 64) ? 1 : 0;
+        }
+    }
+
+    static inline void add(Fp &out, const Fp &a, const Fp &b) {
+        u128 carry = 0;
+        for (int i = 0; i < 4; ++i) {
+            u128 s = (u128)a.l[i] + b.l[i] + carry;
+            out.l[i] = (uint64_t)s;
+            carry = s >> 64;
+        }
+        if (carry || geq_p(out.l)) sub_p(out.l);
+    }
+
+    static inline void sub(Fp &out, const Fp &a, const Fp &b) {
+        const uint64_t *m = P::mod();
+        u128 borrow = 0;
+        for (int i = 0; i < 4; ++i) {
+            u128 d = (u128)a.l[i] - b.l[i] - borrow;
+            out.l[i] = (uint64_t)d;
+            borrow = (d >> 64) ? 1 : 0;
+        }
+        if (borrow) {
+            u128 carry = 0;
+            for (int i = 0; i < 4; ++i) {
+                u128 s = (u128)out.l[i] + m[i] + carry;
+                out.l[i] = (uint64_t)s;
+                carry = s >> 64;
+            }
+        }
+    }
+
+    static inline void neg(Fp &out, const Fp &a) {
+        Fp zero;
+        memset(zero.l, 0, 32);
+        sub(out, zero, a);
+    }
+
+    // Montgomery CIOS multiplication.
+    static void mul(Fp &out, const Fp &a, const Fp &b) {
+        const uint64_t *m = P::mod();
+        const uint64_t pinv = P::pinv();
+        uint64_t t[6] = {0, 0, 0, 0, 0, 0};
+        for (int i = 0; i < 4; ++i) {
+            u128 carry = 0;
+            for (int j = 0; j < 4; ++j) {
+                u128 cur = (u128)t[j] + (u128)a.l[i] * b.l[j] + carry;
+                t[j] = (uint64_t)cur;
+                carry = cur >> 64;
+            }
+            u128 cur = (u128)t[4] + carry;
+            t[4] = (uint64_t)cur;
+            t[5] = (uint64_t)(cur >> 64);
+
+            uint64_t mm = t[0] * pinv;
+            carry = ((u128)t[0] + (u128)mm * m[0]) >> 64;
+            for (int j = 1; j < 4; ++j) {
+                u128 c2 = (u128)t[j] + (u128)mm * m[j] + carry;
+                t[j - 1] = (uint64_t)c2;
+                carry = c2 >> 64;
+            }
+            cur = (u128)t[4] + carry;
+            t[3] = (uint64_t)cur;
+            t[4] = t[5] + (uint64_t)(cur >> 64);
+            t[5] = 0;
+        }
+        memcpy(out.l, t, 32);
+        if (t[4] || geq_p(out.l)) sub_p(out.l);
+    }
+
+    static inline void sqr(Fp &out, const Fp &a) { mul(out, a, a); }
+
+    static inline bool is_zero(const Fp &a) {
+        return !(a.l[0] | a.l[1] | a.l[2] | a.l[3]);
+    }
+
+    static inline bool eq(const Fp &a, const Fp &b) { return !memcmp(a.l, b.l, 32); }
+
+    static void to_mont(Fp &out, const uint64_t canon[4]) {
+        Fp a, r2;
+        memcpy(a.l, canon, 32);
+        memcpy(r2.l, P::r2(), 32);
+        mul(out, a, r2);
+    }
+
+    static void from_mont(uint64_t canon[4], const Fp &a) {
+        Fp one = {{1, 0, 0, 0}};
+        Fp res;
+        mul(res, a, one);
+        memcpy(canon, res.l, 32);
+    }
+
+    static void set_one(Fp &out) { memcpy(out.l, P::one(), 32); }
+
+    // out = a^e for a canonical 4-limb exponent (square-and-multiply).
+    static void pow(Fp &out, const Fp &a, const uint64_t e[4]) {
+        Fp result, base = a;
+        set_one(result);
+        for (int limb = 0; limb < 4; ++limb) {
+            uint64_t bits = e[limb];
+            for (int i = 0; i < 64; ++i) {
+                if ((limb * 64 + i) >= 254 && !bits) break;
+                if (bits & 1) mul(result, result, base);
+                sqr(base, base);
+                bits >>= 1;
+            }
+        }
+        out = result;
+    }
+
+    // out = a^(p-2) = a^-1 (a != 0).
+    static void inv(Fp &out, const Fp &a) {
+        uint64_t e[4];
+        memcpy(e, P::mod(), 32);
+        // p - 2 (p is odd and > 2, no borrow past limb 0 unless l0 < 2)
+        if (e[0] >= 2) {
+            e[0] -= 2;
+        } else {
+            u128 borrow = 2;
+            for (int i = 0; i < 4; ++i) {
+                u128 d = (u128)e[i] - borrow;
+                e[i] = (uint64_t)d;
+                borrow = (d >> 64) ? 1 : 0;
+            }
+        }
+        pow(out, a, e);
+    }
+};
+
+typedef Fp<FrP> FrF;
+typedef Fp<FqP> FqF;
+
+// ---------------------------------------------------------------------
+// NTT over Fr (radix-2, in-place, bit-reversed ordering internally).
+
+static void bit_reverse_permute(FrF *data, int64_t n) {
+    int log_n = 0;
+    while ((1LL << log_n) < n) ++log_n;
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t rev = 0;
+        for (int b = 0; b < log_n; ++b) {
+            if (i & (1LL << b)) rev |= 1LL << (log_n - 1 - b);
+        }
+        if (i < rev) {
+            FrF tmp = data[i];
+            data[i] = data[rev];
+            data[rev] = tmp;
+        }
+    }
+}
+
+extern "C" {
+
+int64_t zk_abi_version() { return 1; }
+
+// In-place NTT of `data` (n x 4 canonical limbs).  `root_canon` must be
+// a primitive n-th root of unity (pass the inverse root for the inverse
+// transform; inverse=1 additionally scales by n^-1).
+void zk_ntt(uint64_t *data, int64_t n, const uint64_t *root_canon, int inverse) {
+    std::vector<FrF> buf(n);
+    for (int64_t i = 0; i < n; ++i) FrF::to_mont(buf[i], data + 4 * i);
+
+    FrF root;
+    FrF::to_mont(root, root_canon);
+
+    // Twiddle table: root^0 .. root^(n/2-1).
+    std::vector<FrF> tw(n / 2);
+    if (n >= 2) {
+        FrF::set_one(tw[0]);
+        for (int64_t i = 1; i < n / 2; ++i) FrF::mul(tw[i], tw[i - 1], root);
+    }
+
+    bit_reverse_permute(buf.data(), n);
+
+    for (int64_t len = 2; len <= n; len <<= 1) {
+        int64_t half = len >> 1;
+        int64_t step = n / len;
+#pragma omp parallel for schedule(static) if (n >= 4096)
+        for (int64_t start = 0; start < n; start += len) {
+            for (int64_t j = 0; j < half; ++j) {
+                FrF u = buf[start + j];
+                FrF t;
+                FrF::mul(t, buf[start + j + half], tw[j * step]);
+                FrF::add(buf[start + j], u, t);
+                FrF::sub(buf[start + j + half], u, t);
+            }
+        }
+    }
+
+    if (inverse) {
+        // n^-1: n fits in one limb for any practical domain.
+        FrF n_f = {{(uint64_t)n, 0, 0, 0}}, n_mont, n_inv;
+        FrF r2;
+        memcpy(r2.l, FrP::r2(), 32);
+        FrF::mul(n_mont, n_f, r2);
+        FrF::inv(n_inv, n_mont);
+        for (int64_t i = 0; i < n; ++i) FrF::mul(buf[i], buf[i], n_inv);
+    }
+
+    for (int64_t i = 0; i < n; ++i) FrF::from_mont(data + 4 * i, buf[i]);
+}
+
+void zk_vec_mul(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) {
+#pragma omp parallel for schedule(static) if (n >= 4096)
+    for (int64_t i = 0; i < n; ++i) {
+        FrF x, y, z;
+        FrF::to_mont(x, a + 4 * i);
+        FrF::to_mont(y, b + 4 * i);
+        FrF::mul(z, x, y);
+        FrF::from_mont(out + 4 * i, z);
+    }
+}
+
+void zk_vec_add(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) {
+#pragma omp parallel for schedule(static) if (n >= 4096)
+    for (int64_t i = 0; i < n; ++i) {
+        // canonical add/sub don't need the Montgomery domain
+        FrF x, y, z;
+        memcpy(x.l, a + 4 * i, 32);
+        memcpy(y.l, b + 4 * i, 32);
+        FrF::add(z, x, y);
+        memcpy(out + 4 * i, z.l, 32);
+    }
+}
+
+void zk_vec_sub(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) {
+#pragma omp parallel for schedule(static) if (n >= 4096)
+    for (int64_t i = 0; i < n; ++i) {
+        FrF x, y, z;
+        memcpy(x.l, a + 4 * i, 32);
+        memcpy(y.l, b + 4 * i, 32);
+        FrF::sub(z, x, y);
+        memcpy(out + 4 * i, z.l, 32);
+    }
+}
+
+// Batch modular inverse (Montgomery trick); zeros invert to zero.
+void zk_batch_inv(const uint64_t *a, uint64_t *out, int64_t n) {
+    std::vector<FrF> vals(n), prefix(n);
+    FrF acc;
+    FrF::set_one(acc);
+    for (int64_t i = 0; i < n; ++i) {
+        FrF::to_mont(vals[i], a + 4 * i);
+        prefix[i] = acc;
+        if (!FrF::is_zero(vals[i])) FrF::mul(acc, acc, vals[i]);
+    }
+    FrF inv_all;
+    FrF::inv(inv_all, acc);
+    for (int64_t i = n - 1; i >= 0; --i) {
+        if (FrF::is_zero(vals[i])) {
+            memset(out + 4 * i, 0, 32);
+            continue;
+        }
+        FrF res;
+        FrF::mul(res, inv_all, prefix[i]);
+        FrF::from_mont(out + 4 * i, res);
+        FrF::mul(inv_all, inv_all, vals[i]);
+    }
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// G1 (short Weierstrass y^2 = x^3 + 3 over Fq), Jacobian coordinates.
+
+struct G1J {
+    FqF x, y, z;  // z == 0 -> identity
+};
+
+static inline bool g1_is_identity(const G1J &p) { return FqF::is_zero(p.z); }
+
+static void g1_set_identity(G1J &p) {
+    memset(&p, 0, sizeof(p));
+    FqF::set_one(p.x);
+    FqF::set_one(p.y);
+}
+
+// dbl-2009-l (a = 0).
+static void g1_double(G1J &out, const G1J &p) {
+    if (g1_is_identity(p) || FqF::is_zero(p.y)) {
+        g1_set_identity(out);
+        return;
+    }
+    FqF a, b, c, d, e, f, t, t2;
+    FqF::sqr(a, p.x);                  // A = X^2
+    FqF::sqr(b, p.y);                  // B = Y^2
+    FqF::sqr(c, b);                    // C = B^2
+    FqF::add(t, p.x, b);               // X+B
+    FqF::sqr(t, t);                    // (X+B)^2
+    FqF::sub(t, t, a);                 //  - A
+    FqF::sub(t, t, c);                 //  - C
+    FqF::add(d, t, t);                 // D = 2 * ...
+    FqF::add(e, a, a);                 // E = 3A
+    FqF::add(e, e, a);
+    FqF::sqr(f, e);                    // F = E^2
+    G1J res;
+    FqF::add(t, d, d);                 // 2D
+    FqF::sub(res.x, f, t);             // X3 = F - 2D
+    FqF::sub(t, d, res.x);             // D - X3
+    FqF::mul(t, e, t);                 // E(D - X3)
+    FqF::add(t2, c, c);                // 8C
+    FqF::add(t2, t2, t2);
+    FqF::add(t2, t2, t2);
+    FqF::sub(res.y, t, t2);            // Y3
+    FqF::mul(t, p.y, p.z);             // YZ
+    FqF::add(res.z, t, t);             // Z3 = 2YZ
+    out = res;
+}
+
+// Mixed addition with affine q (madd-2007-bl, a = 0).
+static void g1_add_affine(G1J &out, const G1J &p, const FqF &qx, const FqF &qy) {
+    if (g1_is_identity(p)) {
+        out.x = qx;
+        out.y = qy;
+        FqF::set_one(out.z);
+        return;
+    }
+    FqF z1z1, u2, s2, h, hh, i, j, r, v, t, t2;
+    FqF::sqr(z1z1, p.z);
+    FqF::mul(u2, qx, z1z1);
+    FqF::mul(s2, qy, p.z);
+    FqF::mul(s2, s2, z1z1);
+    FqF::sub(h, u2, p.x);
+    FqF::sub(r, s2, p.y);
+    if (FqF::is_zero(h)) {
+        if (FqF::is_zero(r)) {
+            g1_double(out, p);
+            return;
+        }
+        g1_set_identity(out);
+        return;
+    }
+    FqF::add(r, r, r);                 // r = 2(S2 - Y1)
+    FqF::sqr(hh, h);
+    FqF::add(i, hh, hh);               // I = 4 HH
+    FqF::add(i, i, i);
+    FqF::mul(j, h, i);                 // J = H I
+    FqF::mul(v, p.x, i);               // V = X1 I
+    G1J res;
+    FqF::sqr(t, r);
+    FqF::sub(t, t, j);
+    FqF::add(t2, v, v);
+    FqF::sub(res.x, t, t2);            // X3 = r^2 - J - 2V
+    FqF::sub(t, v, res.x);
+    FqF::mul(t, r, t);
+    FqF::mul(t2, p.y, j);
+    FqF::add(t2, t2, t2);
+    FqF::sub(res.y, t, t2);            // Y3 = r(V-X3) - 2 Y1 J
+    FqF::add(t, p.z, h);
+    FqF::sqr(t, t);
+    FqF::sub(t, t, z1z1);
+    FqF::sub(res.z, t, hh);            // Z3 = (Z1+H)^2 - Z1Z1 - HH
+    out = res;
+}
+
+// Full Jacobian addition (add-2007-bl, a = 0).
+static void g1_add(G1J &out, const G1J &p, const G1J &q) {
+    if (g1_is_identity(p)) {
+        out = q;
+        return;
+    }
+    if (g1_is_identity(q)) {
+        out = p;
+        return;
+    }
+    FqF z1z1, z2z2, u1, u2, s1, s2, h, i, j, r, v, t, t2;
+    FqF::sqr(z1z1, p.z);
+    FqF::sqr(z2z2, q.z);
+    FqF::mul(u1, p.x, z2z2);
+    FqF::mul(u2, q.x, z1z1);
+    FqF::mul(s1, p.y, q.z);
+    FqF::mul(s1, s1, z2z2);
+    FqF::mul(s2, q.y, p.z);
+    FqF::mul(s2, s2, z1z1);
+    FqF::sub(h, u2, u1);
+    FqF::sub(r, s2, s1);
+    if (FqF::is_zero(h)) {
+        if (FqF::is_zero(r)) {
+            g1_double(out, p);
+            return;
+        }
+        g1_set_identity(out);
+        return;
+    }
+    FqF::add(t, h, h);
+    FqF::sqr(i, t);                    // I = (2H)^2
+    FqF::mul(j, h, i);
+    FqF::add(r, r, r);                 // r = 2(S2-S1)
+    FqF::mul(v, u1, i);
+    G1J res;
+    FqF::sqr(t, r);
+    FqF::sub(t, t, j);
+    FqF::add(t2, v, v);
+    FqF::sub(res.x, t, t2);
+    FqF::sub(t, v, res.x);
+    FqF::mul(t, r, t);
+    FqF::mul(t2, s1, j);
+    FqF::add(t2, t2, t2);
+    FqF::sub(res.y, t, t2);
+    FqF::add(t, p.z, q.z);
+    FqF::sqr(t, t);
+    FqF::sub(t, t, z1z1);
+    FqF::sub(t, t, z2z2);
+    FqF::mul(res.z, t, h);
+    out = res;
+}
+
+static void g1_to_affine(uint64_t out[8], const G1J &p) {
+    if (g1_is_identity(p)) {
+        memset(out, 0, 64);
+        return;
+    }
+    FqF zinv, zinv2, zinv3, ax, ay;
+    FqF::inv(zinv, p.z);
+    FqF::sqr(zinv2, zinv);
+    FqF::mul(zinv3, zinv2, zinv);
+    FqF::mul(ax, p.x, zinv2);
+    FqF::mul(ay, p.y, zinv3);
+    FqF::from_mont(out, ax);
+    FqF::from_mont(out + 4, ay);
+}
+
+extern "C" {
+
+// Pippenger MSM: scalars n x 4, points n x 8 (affine canonical), out 8.
+void zk_msm(const uint64_t *scalars, const uint64_t *points, int64_t n, uint64_t *out) {
+    if (n == 0) {
+        memset(out, 0, 64);
+        return;
+    }
+    // Window size heuristic.
+    int c = 3;
+    {
+        int64_t m = n;
+        int logn = 0;
+        while (m > 1) {
+            m >>= 1;
+            ++logn;
+        }
+        c = logn > 8 ? logn - 4 : 4;
+        if (c < 3) c = 3;
+        if (c > 16) c = 16;
+    }
+    int n_windows = (254 + c - 1) / c;
+    int64_t n_buckets = (1LL << c) - 1;
+
+    // Convert points to Montgomery once.
+    std::vector<FqF> px(n), py(n);
+    std::vector<bool> is_id(n);
+    for (int64_t i = 0; i < n; ++i) {
+        FqF::to_mont(px[i], points + 8 * i);
+        FqF::to_mont(py[i], points + 8 * i + 4);
+        is_id[i] = !(points[8 * i] | points[8 * i + 1] | points[8 * i + 2] |
+                     points[8 * i + 3] | points[8 * i + 4] | points[8 * i + 5] |
+                     points[8 * i + 6] | points[8 * i + 7]);
+    }
+
+    std::vector<G1J> window_sums(n_windows);
+
+#pragma omp parallel for schedule(dynamic)
+    for (int w = 0; w < n_windows; ++w) {
+        std::vector<G1J> buckets(n_buckets);
+        for (int64_t b = 0; b < n_buckets; ++b) g1_set_identity(buckets[b]);
+        int shift = w * c;
+        for (int64_t i = 0; i < n; ++i) {
+            if (is_id[i]) continue;
+            // Extract c bits starting at `shift` from the 256-bit scalar.
+            int limb = shift / 64, off = shift % 64;
+            uint64_t digit = scalars[4 * i + limb] >> off;
+            if (off && limb < 3) digit |= scalars[4 * i + limb + 1] << (64 - off);
+            digit &= (uint64_t)n_buckets;  // mask c bits (n_buckets = 2^c - 1)
+            if (!digit) continue;
+            g1_add_affine(buckets[digit - 1], buckets[digit - 1], px[i], py[i]);
+        }
+        // Running-sum reduction: sum_b (b+1) * buckets[b].
+        G1J acc, partial;
+        g1_set_identity(acc);
+        g1_set_identity(partial);
+        for (int64_t b = n_buckets - 1; b >= 0; --b) {
+            g1_add(acc, acc, buckets[b]);
+            g1_add(partial, partial, acc);
+        }
+        window_sums[w] = partial;
+    }
+
+    G1J total;
+    g1_set_identity(total);
+    for (int w = n_windows - 1; w >= 0; --w) {
+        for (int bit = 0; bit < c; ++bit) g1_double(total, total);
+        g1_add(total, total, window_sums[w]);
+    }
+    g1_to_affine(out, total);
+}
+
+// SRS ladder: out[i] = tau^i * G1 for i < n (generator (1, 2)).
+void zk_srs_powers(const uint64_t *tau, int64_t n, uint64_t *out) {
+    // Scalar ladder in Fr.
+    std::vector<FrF> scal(n);
+    FrF t, acc;
+    FrF::to_mont(t, tau);
+    FrF::set_one(acc);
+    for (int64_t i = 0; i < n; ++i) {
+        scal[i] = acc;
+        FrF::mul(acc, acc, t);
+    }
+    uint64_t gen[8] = {1, 0, 0, 0, 2, 0, 0, 0};
+    FqF gx, gy;
+    FqF::to_mont(gx, gen);
+    FqF::to_mont(gy, gen + 4);
+
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t e[4];
+        FrF::from_mont(e, scal[i]);
+        G1J r;
+        g1_set_identity(r);
+        // MSB-first double-and-add.
+        for (int bit = 253; bit >= 0; --bit) {
+            g1_double(r, r);
+            if ((e[bit / 64] >> (bit % 64)) & 1) g1_add_affine(r, r, gx, gy);
+        }
+        g1_to_affine(out + 8 * i, r);
+    }
+}
+
+// ---------------------------------------------------------------------
+// Gate-program evaluator.
+//
+// Stack machine over Fr evaluated at every point of a domain: columns
+// are (n_cols x m x 4) canonical values; rotations index as
+// (i + rot * rot_stride) mod m.  Opcodes (flat int64 stream):
+//   0 col rot   push columns[col] at rotation rot
+//   1 idx       push consts[idx]
+//   2           add
+//   3           sub
+//   4           mul
+//   5           neg
+// Output: m x 4 canonical.
+
+static const int ZK_EVAL_STACK = 64;
+
+// Pre-pass: simulate stack depth and bounds-check every operand so a
+// malformed program can't overflow the per-thread stack or index out of
+// cols/consts.  Returns the final stack depth, or -1 if invalid.
+static int zk_validate_program(int64_t n_cols, const int64_t *code,
+                               int64_t code_len, int64_t n_consts) {
+    int sp = 0;
+    for (int64_t pc = 0; pc < code_len;) {
+        int64_t op = code[pc++];
+        switch (op) {
+        case 0:
+            if (pc + 2 > code_len) return -1;
+            if (code[pc] < 0 || code[pc] >= n_cols) return -1;
+            pc += 2;
+            if (++sp > ZK_EVAL_STACK) return -1;
+            break;
+        case 1:
+            if (pc + 1 > code_len) return -1;
+            if (code[pc] < 0 || code[pc] >= n_consts) return -1;
+            pc += 1;
+            if (++sp > ZK_EVAL_STACK) return -1;
+            break;
+        case 2:
+        case 3:
+        case 4:
+            if (sp < 2) return -1;
+            --sp;
+            break;
+        case 5:
+            if (sp < 1) return -1;
+            break;
+        default:
+            return -1;
+        }
+    }
+    return sp;
+}
+
+// Returns 0 on success, -1 if the program is malformed.
+int64_t zk_eval_program(int64_t m, int64_t n_cols, const uint64_t *cols,
+                        int64_t rot_stride, const int64_t *code, int64_t code_len,
+                        const uint64_t *consts, int64_t n_consts, uint64_t *out) {
+    if (zk_validate_program(n_cols, code, code_len, n_consts) != 1) return -1;
+    std::vector<FrF> cmont(n_consts);
+    for (int64_t i = 0; i < n_consts; ++i) FrF::to_mont(cmont[i], consts + 4 * i);
+
+#pragma omp parallel
+    {
+        std::vector<FrF> stack(ZK_EVAL_STACK);
+#pragma omp for schedule(static)
+        for (int64_t i = 0; i < m; ++i) {
+            int sp = 0;
+            for (int64_t pc = 0; pc < code_len;) {
+                int64_t op = code[pc++];
+                switch (op) {
+                case 0: {
+                    int64_t col = code[pc++];
+                    int64_t rot = code[pc++];
+                    int64_t idx = (i + rot * rot_stride) % m;
+                    if (idx < 0) idx += m;
+                    FrF::to_mont(stack[sp++], cols + 4 * (col * m + idx));
+                    break;
+                }
+                case 1:
+                    stack[sp++] = cmont[code[pc++]];
+                    break;
+                case 2:
+                    --sp;
+                    FrF::add(stack[sp - 1], stack[sp - 1], stack[sp]);
+                    break;
+                case 3:
+                    --sp;
+                    FrF::sub(stack[sp - 1], stack[sp - 1], stack[sp]);
+                    break;
+                case 4:
+                    --sp;
+                    FrF::mul(stack[sp - 1], stack[sp - 1], stack[sp]);
+                    break;
+                case 5:
+                    FrF::neg(stack[sp - 1], stack[sp - 1]);
+                    break;
+                }
+            }
+            FrF::from_mont(out + 4 * i, stack[0]);
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

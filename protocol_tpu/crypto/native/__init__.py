@@ -59,28 +59,9 @@ def available() -> bool:
         return False
 
 
-def _to_limbs(values: list[int]) -> np.ndarray:
-    """ints -> (n, 4) u64 canonical little-endian limb array."""
-    out = np.empty((len(values), 4), dtype=np.uint64)
-    mask = (1 << 64) - 1
-    for i, v in enumerate(values):
-        out[i, 0] = v & mask
-        out[i, 1] = (v >> 64) & mask
-        out[i, 2] = (v >> 128) & mask
-        out[i, 3] = (v >> 192) & mask
-    return out
-
-
-def _from_limbs(arr: np.ndarray) -> list[int]:
-    arr = arr.astype(object)
-    return [
-        int(row[0]) | int(row[1]) << 64 | int(row[2]) << 128 | int(row[3]) << 192
-        for row in arr
-    ]
-
-
-def _ptr(arr: np.ndarray):
-    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+from ...utils.limbs import from_limbs as _from_limbs  # noqa: E402
+from ...utils.limbs import ptr as _ptr  # noqa: E402
+from ...utils.limbs import to_limbs as _to_limbs  # noqa: E402
 
 
 def poseidon_permute_batch(inputs: list[list[int]]) -> list[list[int]]:

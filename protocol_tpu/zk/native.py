@@ -1,0 +1,179 @@
+"""ctypes bindings for the C++ ZK proving runtime (native/zk_runtime.cpp).
+
+NTT, Pippenger MSM, SRS ladder, vectorized field ops, and the gate
+bytecode evaluator — the hot loops of KZG/PLONK proving (the analog of
+halo2's Rust backend behind create_proof, circuit/src/utils.rs:259-281).
+Every caller has a pure-Python fallback gated on ``available()``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+from ..crypto.field import MODULUS as R
+from ..utils.limbs import _MASK, U64P as _U64P, from_limbs, ptr as _ptr, to_limbs
+from .bn254 import G1
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libzk_runtime.so"
+_lib = None  # None = untried, False = failed, else CDLL
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+def _load():
+    global _lib
+    if _lib is False:
+        raise OSError("zk native runtime unavailable (previous build failed)")
+    if _lib is not None:
+        return _lib
+    if not _LIB_PATH.exists():
+        try:
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR), "libzk_runtime.so"],
+                check=True,
+                capture_output=True,
+            )
+        except Exception:
+            _lib = False
+            raise
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.zk_ntt.argtypes = [_U64P, ctypes.c_int64, _U64P, ctypes.c_int]
+    lib.zk_vec_mul.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
+    lib.zk_vec_add.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
+    lib.zk_vec_sub.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
+    lib.zk_batch_inv.argtypes = [_U64P, _U64P, ctypes.c_int64]
+    lib.zk_msm.argtypes = [_U64P, _U64P, ctypes.c_int64, _U64P]
+    lib.zk_srs_powers.argtypes = [_U64P, ctypes.c_int64, _U64P]
+    lib.zk_eval_program.argtypes = [
+        ctypes.c_int64,
+        ctypes.c_int64,
+        _U64P,
+        ctypes.c_int64,
+        _I64P,
+        ctypes.c_int64,
+        _U64P,
+        ctypes.c_int64,
+        _U64P,
+    ]
+    lib.zk_eval_program.restype = ctypes.c_int64
+    lib.zk_abi_version.restype = ctypes.c_int64
+    assert lib.zk_abi_version() == 1
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    global _lib
+    try:
+        _load()
+        return True
+    except (OSError, subprocess.CalledProcessError, AssertionError):
+        _lib = False
+        return False
+
+
+def _iptr(arr: np.ndarray):
+    return arr.ctypes.data_as(_I64P)
+
+
+# -- public ops --------------------------------------------------------
+
+
+def ntt(values: list[int], root: int, inverse: bool = False) -> list[int]:
+    """In-place radix-2 NTT; `root` must be a primitive len(values)-th
+    root of unity in Fr (pass the inverse root with inverse=True)."""
+    lib = _load()
+    n = len(values)
+    assert n & (n - 1) == 0, "NTT size must be a power of two"
+    data = to_limbs(values)
+    root_l = to_limbs([root])
+    lib.zk_ntt(_ptr(data), n, _ptr(root_l), 1 if inverse else 0)
+    return from_limbs(data)
+
+
+def vec_mul(a: list[int], b: list[int]) -> list[int]:
+    lib = _load()
+    al, bl = to_limbs(a), to_limbs(b)
+    out = np.empty_like(al)
+    lib.zk_vec_mul(_ptr(al), _ptr(bl), _ptr(out), len(a))
+    return from_limbs(out)
+
+
+def batch_inv(a: list[int]) -> list[int]:
+    lib = _load()
+    al = to_limbs(a)
+    out = np.empty_like(al)
+    lib.zk_batch_inv(_ptr(al), _ptr(out), len(a))
+    return from_limbs(out)
+
+
+def _points_to_limbs(points: list[G1]) -> np.ndarray:
+    out = np.empty((len(points), 8), dtype=np.uint64)
+    for i, p in enumerate(points):
+        for j in range(4):
+            out[i, j] = (p.x >> (64 * j)) & _MASK
+            out[i, 4 + j] = (p.y >> (64 * j)) & _MASK
+    return out
+
+
+def _limbs_to_point(arr: np.ndarray) -> G1:
+    vals = arr.astype(object)
+    x = int(vals[0]) | int(vals[1]) << 64 | int(vals[2]) << 128 | int(vals[3]) << 192
+    y = int(vals[4]) | int(vals[5]) << 64 | int(vals[6]) << 128 | int(vals[7]) << 192
+    return G1(x, y)
+
+
+def msm(scalars: list[int], points: list[G1]) -> G1:
+    lib = _load()
+    n = len(scalars)
+    s = to_limbs([x % R for x in scalars])
+    p = _points_to_limbs(points[:n])
+    out = np.zeros(8, dtype=np.uint64)
+    lib.zk_msm(_ptr(s), _ptr(p), n, _ptr(out))
+    return _limbs_to_point(out)
+
+
+def srs_g1_powers(tau: int, n: int) -> list[G1]:
+    lib = _load()
+    t = to_limbs([tau % R])
+    out = np.empty((n, 8), dtype=np.uint64)
+    lib.zk_srs_powers(_ptr(t), n, _ptr(out))
+    return [_limbs_to_point(out[i]) for i in range(n)]
+
+
+def eval_program(
+    m: int,
+    columns: np.ndarray,
+    rot_stride: int,
+    code: list[int],
+    consts: list[int],
+) -> list[int]:
+    """Run the gate bytecode over all m points.  ``columns`` is an
+    (n_cols, m, 4) uint64 array of canonical limbs."""
+    lib = _load()
+    n_cols = columns.shape[0] if columns.size else 0
+    cols = np.ascontiguousarray(columns, dtype=np.uint64)
+    code_arr = np.asarray(code, dtype=np.int64)
+    consts_arr = to_limbs(consts) if consts else np.zeros((1, 4), dtype=np.uint64)
+    out = np.empty((m, 4), dtype=np.uint64)
+    rc = lib.zk_eval_program(
+        m,
+        n_cols,
+        _ptr(cols),
+        rot_stride,
+        _iptr(code_arr),
+        len(code_arr),
+        _ptr(consts_arr),
+        len(consts) if consts else 0,
+        _ptr(out),
+    )
+    if rc != 0:
+        raise ValueError(
+            "malformed gate program (stack depth, operand index, or truncation)"
+        )
+    return from_limbs(out)

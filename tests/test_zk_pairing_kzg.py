@@ -1,0 +1,206 @@
+"""Pairing, native proving runtime, and KZG commitment tests.
+
+Covers the curve/commitment layer the reference gets from halo2curves +
+halo2's KZG backend (circuit/src/utils.rs:198-321): the Bn254 tower and
+ate pairing, the C++ NTT/MSM/eval kernels (parity against pure Python),
+and commit/open/verify round trips.
+"""
+
+import random
+
+import pytest
+
+from protocol_tpu.crypto.field import MODULUS as R
+from protocol_tpu.zk import native
+from protocol_tpu.zk.bn254 import G1, GENERATOR, IDENTITY, is_on_curve
+from protocol_tpu.zk.fields import (
+    FQ2,
+    FQP,
+    G2_GENERATOR,
+    g2_in_subgroup,
+    g2_is_on_curve,
+    pairing,
+    pairing_check,
+)
+from protocol_tpu.zk.kzg import Setup, _eval_poly, _msm_python, msm
+
+rnd = random.Random(0xE1)
+
+
+# -- tower ------------------------------------------------------------
+
+
+def test_fq2_arithmetic():
+    from protocol_tpu.zk.rns import FQ_MODULUS as Q
+
+    # (3 + 5u)(7 + 11u) = 21 + 68u + 55u^2 = (21 - 55) + 68u  (u^2 = -1)
+    a = FQ2([3, 5])
+    b = FQ2([7, 11])
+    assert (a * b).coeffs == [(21 - 55) % Q, 68]
+
+
+def test_fqp_inverse_roundtrip():
+    x = FQP([rnd.randrange(1 << 60) for _ in range(12)])
+    assert x * x.inv() == FQP.one()
+
+
+def test_fq2_inverse_roundtrip():
+    x = FQ2([rnd.randrange(1 << 60), rnd.randrange(1 << 60)])
+    assert (x * x.inv()).coeffs == [1, 0]
+
+
+# -- G2 ---------------------------------------------------------------
+
+
+def test_g2_generator_on_curve_and_in_subgroup():
+    assert g2_is_on_curve(G2_GENERATOR)
+    assert g2_in_subgroup(G2_GENERATOR)
+
+
+def test_g2_group_laws():
+    p2 = G2_GENERATOR.double()
+    assert g2_is_on_curve(p2)
+    assert G2_GENERATOR.add(G2_GENERATOR) == p2
+    assert G2_GENERATOR.mul(5) == p2.add(p2).add(G2_GENERATOR)
+    assert G2_GENERATOR.add(G2_GENERATOR.neg()).is_identity()
+
+
+# -- pairing ----------------------------------------------------------
+
+
+def test_pairing_non_degenerate():
+    e = pairing(G2_GENERATOR, GENERATOR)
+    assert e != FQP.one()
+    assert e.pow(R) == FQP.one()
+
+
+def test_pairing_bilinearity():
+    a, b = 1234567, 987654321
+    e = pairing(G2_GENERATOR, GENERATOR)
+    assert pairing(G2_GENERATOR.mul(b), GENERATOR.mul(a)) == e.pow(a * b % R)
+    assert pairing(G2_GENERATOR, GENERATOR.mul(a)) == e.pow(a)
+
+
+def test_pairing_check_product():
+    # e(5G, H) * e(-5G, H) == 1 ; replacing -5 with -4 must fail.
+    g5 = GENERATOR.mul(5)
+    assert pairing_check([(g5, G2_GENERATOR), (g5.neg(), G2_GENERATOR)])
+    assert not pairing_check(
+        [(g5, G2_GENERATOR), (GENERATOR.mul(4).neg(), G2_GENERATOR)]
+    )
+
+
+def test_pairing_identity_inputs():
+    assert pairing(G2_GENERATOR, IDENTITY) == FQP.one()
+
+
+# -- native runtime ---------------------------------------------------
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="zk native runtime not built"
+)
+
+
+@needs_native
+def test_ntt_roundtrip_and_evaluation():
+    n = 64
+    root = pow(7, (R - 1) // n, R)
+    vals = [rnd.randrange(R) for _ in range(n)]
+    f = native.ntt(list(vals), root)
+    assert native.ntt(f, pow(root, -1, R), inverse=True) == vals
+    # The forward transform evaluates at root^i.
+    x = pow(root, 5, R)
+    assert f[5] == sum(c * pow(x, i, R) for i, c in enumerate(vals)) % R
+
+
+@needs_native
+def test_batch_inv_with_zeros():
+    a = [rnd.randrange(1, R) for _ in range(17)] + [0]
+    inv = native.batch_inv(a)
+    assert inv[-1] == 0
+    assert all(x * y % R == 1 for x, y in zip(a[:-1], inv[:-1]))
+
+
+@needs_native
+def test_msm_native_matches_python():
+    pts = [GENERATOR.mul(rnd.randrange(1, 10_000)) for _ in range(64)] + [IDENTITY]
+    scs = [rnd.randrange(R) for _ in range(65)]
+    assert native.msm(scs, pts) == _msm_python(scs, pts)
+
+
+@needs_native
+def test_srs_powers_native():
+    tau = 987654321987654321
+    powers = native.srs_g1_powers(tau, 32)
+    for i in (0, 1, 13, 31):
+        assert powers[i] == GENERATOR.mul(pow(tau, i, R))
+
+
+def test_msm_python_small():
+    pts = [GENERATOR, GENERATOR.mul(2)]
+    assert _msm_python([3, 4], pts) == GENERATOR.mul(11)
+    assert _msm_python([], []) == IDENTITY
+
+
+# -- KZG --------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def setup_k6():
+    return Setup.generate(6)
+
+
+def test_kzg_srs_structure(setup_k6):
+    s = setup_k6
+    assert len(s.g1_powers) == 64
+    assert s.g1_powers[0] == GENERATOR
+    assert all(is_on_curve(p) for p in s.g1_powers[:4])
+    # tau consistency across G1/G2: e(tau G1, G2) == e(G1, tau G2)
+    assert pairing(s.g2, s.g1_powers[1]) == pairing(s.tau_g2, GENERATOR)
+
+
+def test_kzg_commit_open_verify(setup_k6):
+    s = setup_k6
+    coeffs = [rnd.randrange(R) for _ in range(64)]
+    c = s.commit(coeffs)
+    z = rnd.randrange(R)
+    y, w = s.open(coeffs, z)
+    assert y == _eval_poly(coeffs, z)
+    assert s.verify(c, z, y, w)
+    assert not s.verify(c, z, (y + 1) % R, w)
+    assert not s.verify(c, (z + 1) % R, y, w)
+
+
+def test_kzg_linearity(setup_k6):
+    """com(f + g) == com(f) + com(g) — the homomorphism the batch
+    opening argument relies on."""
+    s = setup_k6
+    f = [rnd.randrange(R) for _ in range(32)]
+    g = [rnd.randrange(R) for _ in range(32)]
+    fg = [(a + b) % R for a, b in zip(f, g)]
+    assert s.commit(fg) == s.commit(f).add(s.commit(g))
+
+
+def test_kzg_serialization_roundtrip(setup_k6):
+    s = setup_k6
+    s2 = Setup.from_bytes(s.to_bytes())
+    assert s2.k == s.k
+    assert s2.g1_powers == s.g1_powers
+    assert s2.g2 == s.g2 and s2.tau_g2 == s.tau_g2
+
+
+def test_kzg_shrink(setup_k6):
+    s = setup_k6
+    s5 = s.shrink(5)
+    assert s5.g1_powers == s.g1_powers[:32]
+    coeffs = [rnd.randrange(R) for _ in range(32)]
+    c = s5.commit(coeffs)
+    z = rnd.randrange(R)
+    y, w = s5.open(coeffs, z)
+    assert s5.verify(c, z, y, w)
+
+
+def test_msm_dispatcher(setup_k6):
+    scs = [rnd.randrange(R) for _ in range(40)]
+    pts = setup_k6.g1_powers[:40]
+    assert msm(scs, pts) == _msm_python(scs, pts)
