@@ -30,5 +30,19 @@ def from_limbs(arr: np.ndarray) -> list[int]:
     ]
 
 
+def to_limbs_fast(values) -> np.ndarray:
+    """Bulk ints -> (n, 4) limb array via one byte buffer (the per-int
+    numpy indexing in ``to_limbs`` dominates at 2^18-point domains)."""
+    buf = b"".join(v.to_bytes(32, "little") for v in values)
+    return np.frombuffer(buf, dtype=np.uint64).reshape(-1, 4).copy()
+
+
+def from_limbs_fast(arr: np.ndarray) -> list[int]:
+    buf = np.ascontiguousarray(arr, dtype=np.uint64).tobytes()
+    return [
+        int.from_bytes(buf[i : i + 32], "little") for i in range(0, len(buf), 32)
+    ]
+
+
 def ptr(arr: np.ndarray):
     return arr.ctypes.data_as(U64P)

@@ -118,11 +118,18 @@ class Setup:
         expected = 12 + 64 * (1 << k) + 2 * 128
         if len(data) != expected:
             raise ValueError(f"SRS length {len(data)} != expected {expected}")
+        from .rns import FQ_MODULUS
+
         off = 12
         powers = []
         for i in range(1 << k):
             x = int.from_bytes(data[off : off + 32], "little")
             y = int.from_bytes(data[off + 32 : off + 64], "little")
+            # Canonicality mirrors transcript.read_point: a coordinate
+            # >= Fq aliases another point mod Q and breaks affine
+            # arithmetic / native limb packing downstream.
+            if x >= FQ_MODULUS or y >= FQ_MODULUS:
+                raise ValueError(f"SRS G1 power {i} non-canonical")
             p = G1(x, y)
             if not is_on_curve(p):
                 raise ValueError(f"SRS G1 power {i} not on curve")
@@ -132,6 +139,12 @@ class Setup:
         for _ in range(2):
             coords = []
             for _ in range(2):
+                for word_off in (off, off + 32):
+                    if (
+                        int.from_bytes(data[word_off : word_off + 32], "little")
+                        >= FQ_MODULUS
+                    ):
+                        raise ValueError("SRS G2 coordinate non-canonical")
                 c0 = int.from_bytes(data[off : off + 32], "little")
                 c1 = int.from_bytes(data[off + 32 : off + 64], "little")
                 coords.append(FQ2([c0, c1]))

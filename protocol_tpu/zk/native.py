@@ -15,7 +15,14 @@ from pathlib import Path
 import numpy as np
 
 from ..crypto.field import MODULUS as R
-from ..utils.limbs import _MASK, U64P as _U64P, from_limbs, ptr as _ptr, to_limbs
+from ..utils.limbs import (
+    _MASK,
+    U64P as _U64P,
+    from_limbs,
+    ptr as _ptr,
+    to_limbs,
+    to_limbs_fast,
+)
 from .bn254 import G1
 
 _NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
@@ -113,12 +120,10 @@ def batch_inv(a: list[int]) -> list[int]:
 
 
 def _points_to_limbs(points: list[G1]) -> np.ndarray:
-    out = np.empty((len(points), 8), dtype=np.uint64)
-    for i, p in enumerate(points):
-        for j in range(4):
-            out[i, j] = (p.x >> (64 * j)) & _MASK
-            out[i, 4 + j] = (p.y >> (64 * j)) & _MASK
-    return out
+    buf = b"".join(
+        p.x.to_bytes(32, "little") + p.y.to_bytes(32, "little") for p in points
+    )
+    return np.frombuffer(buf, dtype=np.uint64).reshape(-1, 8).copy()
 
 
 def _limbs_to_point(arr: np.ndarray) -> G1:
@@ -131,7 +136,7 @@ def _limbs_to_point(arr: np.ndarray) -> G1:
 def msm(scalars: list[int], points: list[G1]) -> G1:
     lib = _load()
     n = len(scalars)
-    s = to_limbs([x % R for x in scalars])
+    s = to_limbs_fast([x % R for x in scalars])
     p = _points_to_limbs(points[:n])
     out = np.zeros(8, dtype=np.uint64)
     lib.zk_msm(_ptr(s), _ptr(p), n, _ptr(out))
