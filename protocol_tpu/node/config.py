@@ -21,6 +21,10 @@ class ProtocolConfig:
     trust_backend: str = "native-cpu"
     event_fixture: str | None = None
     checkpoint_dir: str | None = None
+    #: "commitment" or "plonk" (real KZG SNARK per epoch).
+    prover: str = "commitment"
+    #: Ceremony SRS file for the PLONK prover (kzg.Setup format).
+    srs_path: str | None = None
 
     @property
     def host(self) -> str:
@@ -43,6 +47,8 @@ class ProtocolConfig:
         cfg.trust_backend = obj.get("trust_backend", cfg.trust_backend)
         cfg.event_fixture = obj.get("event_fixture", cfg.event_fixture)
         cfg.checkpoint_dir = obj.get("checkpoint_dir", cfg.checkpoint_dir)
+        cfg.prover = obj.get("prover", cfg.prover)
+        cfg.srs_path = obj.get("srs_path", cfg.srs_path)
         return cfg
 
     @classmethod

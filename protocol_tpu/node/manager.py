@@ -41,6 +41,14 @@ class ManagerConfig:
     #: Run the constraint-system statement check before each proof —
     #: the reference's always-on MockProver sanity pass.
     check_circuit: bool = True
+    #: Proof backend: "commitment" (fast Poseidon binding) or "plonk"
+    #: (real KZG SNARK; boot-time keygen ~20 s, proving ~50 s/epoch at
+    #: the reference's k=14 circuit size).
+    prover: str = "commitment"
+    #: Optional ceremony SRS file (kzg.Setup.to_bytes format).  Without
+    #: it the PLONK prover generates a fresh random setup at boot —
+    #: sound only for verifiers who trust this node's keygen.
+    srs_path: str | None = None
 
 
 class Manager:
@@ -49,7 +57,27 @@ class Manager:
 
     def __init__(self, config: ManagerConfig | None = None, prover: Prover | None = None):
         self.config = config or ManagerConfig()
-        self.prover = prover or PoseidonCommitmentProver()
+        if prover is None:
+            if self.config.prover == "plonk":
+                # Boot-time keygen, like the reference's MANAGER_STORE
+                # init (server/src/main.rs:70-83).
+                from ..zk.proof import PlonkEpochProver
+
+                prover = PlonkEpochProver(
+                    num_neighbours=self.config.num_neighbours,
+                    num_iter=self.config.num_iter,
+                    initial_score=self.config.initial_score,
+                    scale=self.config.scale,
+                    srs_path=self.config.srs_path,
+                )
+            elif self.config.prover == "commitment":
+                prover = PoseidonCommitmentProver()
+            else:
+                raise ValueError(
+                    f"unknown prover {self.config.prover!r}: "
+                    "expected 'commitment' or 'plonk'"
+                )
+        self.prover = prover
         self.cached_proofs: dict[Epoch, Proof] = {}
         self.attestations: dict[int, Attestation] = {}
         self.cached_results: dict[Epoch, ConvergenceResult] = {}
@@ -197,11 +225,14 @@ class Manager:
 
         # Constraint-level statement check before emitting the proof —
         # the reference runs MockProver::assert_satisfied inside
-        # gen_proof even in release (verifier/mod.rs:62-70).
+        # gen_proof even in release (verifier/mod.rs:62-70).  The
+        # synthesized system is handed to the prover so the k=14
+        # circuit isn't built twice per epoch.
+        witness = {"ops": ops, "attestations": atts}
         if cfg.check_circuit:
             from ..zk.circuit import prove_epoch_statement
 
-            prove_epoch_statement(
+            witness["cs"] = prove_epoch_statement(
                 atts,
                 pub_ins,
                 num_neighbours=cfg.num_neighbours,
@@ -210,7 +241,7 @@ class Manager:
                 scale=cfg.scale,
             )
 
-        proof_bytes = self.prover.prove(pub_ins, {"ops": ops})
+        proof_bytes = self.prover.prove(pub_ins, witness)
         if __debug__:
             assert self.prover.verify(pub_ins, proof_bytes)
         self.cached_proofs[epoch] = Proof(pub_ins=pub_ins, proof=proof_bytes)

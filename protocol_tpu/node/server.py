@@ -70,7 +70,13 @@ class Node:
 
     @classmethod
     def from_config(cls, config: ProtocolConfig) -> "Node":
-        manager = Manager(ManagerConfig(backend=config.trust_backend))
+        manager = Manager(
+            ManagerConfig(
+                backend=config.trust_backend,
+                prover=config.prover,
+                srs_path=config.srs_path,
+            )
+        )
         return cls(config=config, manager=manager)
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):

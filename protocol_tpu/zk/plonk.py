@@ -571,7 +571,12 @@ def compile_circuit(
     sigma_polys = [domain.ifft(v) for v in sigma_values]
 
     if srs is None:
-        srs = Setup.generate(k + 1)
+        # Fresh random tau, discarded after the ladder is built: the
+        # trust model is "whoever ran keygen" (a dev/test setup, or the
+        # booting node operator) — a ceremony SRS should be supplied
+        # via ``srs`` / loaded with Setup.from_bytes for anything whose
+        # verifiers don't trust the prover's machine.
+        srs = Setup.generate(k + 1, seed=secrets.token_bytes(32))
     assert srs.n >= n + 4, "SRS too small for blinded polynomials"
 
     fixed_commits = [srs.commit(p) for p in fixed_polys]
@@ -940,10 +945,11 @@ def prove(
         programs.append(Sym.const(pow(y, y_pow, R)) * con)
         y_pow += 1
 
-    # Refcount slots across programs for early frees.
+    # Refcount slots across programs for early frees (per unique slot
+    # per program, matching the per-program decrement below).
     need: dict[int, int] = {}
     for prog in programs:
-        for slot, _rot in prog.used_cols():
+        for slot in {s for s, _ in prog.used_cols()}:
             need[slot] = need.get(slot, 0) + 1
     acc: np.ndarray | None = None
     for prog in programs:

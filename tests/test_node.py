@@ -233,6 +233,19 @@ class TestConfigAndFixtures:
         assert cfg.host == "0.0.0.0" and cfg.port == 3000
         assert cfg.trust_backend == "native-cpu"
 
+    def test_prover_config_parsed(self):
+        cfg = ProtocolConfig.from_json(
+            '{"prover": "plonk", "srs_path": "/tmp/srs.bin"}'
+        )
+        assert cfg.prover == "plonk" and cfg.srs_path == "/tmp/srs.bin"
+        assert ProtocolConfig.from_json("{}").prover == "commitment"
+
+    def test_unknown_prover_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="unknown prover"):
+            Manager(ManagerConfig(prover="Plonk"))
+
     def test_bootstrap_csv(self):
         nodes = read_bootstrap_csv("data/bootstrap-nodes.csv")
         assert [n.name for n in nodes] == ["Alice", "Bob", "Charlie", "Chuck", "Craig"]

@@ -212,3 +212,22 @@ class TestEpochProof:
         bad = list(pub)
         bad[0] = (bad[0] + 1) % P
         assert not plonk.verify(pk.vk, bad, proof)
+
+    def test_manager_with_plonk_prover(self):
+        """Node integration: a Manager configured with the PLONK
+        backend serves a real SNARK from calculate_proofs (the
+        reference's boot keygen + epoch proving flow,
+        server/src/main.rs:70-83, manager/mod.rs:170-214)."""
+        from protocol_tpu.node.epoch import Epoch
+        from protocol_tpu.node.manager import Manager, ManagerConfig
+
+        mgr = Manager(ManagerConfig(prover="plonk"))
+        mgr.generate_initial_attestations()
+        epoch = Epoch(1)
+        mgr.calculate_proofs(epoch)
+        proof = mgr.cached_proofs[epoch]
+        assert mgr.prover.name == "plonk-kzg"
+        assert mgr.prover.verify(proof.pub_ins, proof.proof)
+        assert not mgr.prover.verify(
+            [(proof.pub_ins[0] + 1) % P] + proof.pub_ins[1:], proof.proof
+        )
