@@ -360,6 +360,22 @@ class GateSpec:
 
 
 @dataclass
+class LookupSpec:
+    """One compiled lookup argument (Halo2-style A'/S' permutation +
+    grand product re-derived for the reserved-last-row domain layout).
+
+    ``table_fixed_idx[j]`` indexes the synthetic fixed column holding
+    tuple element j of the (sorted, padded) table; ``pad`` is the table
+    entry substituted for rows where the selector is off."""
+
+    name: str
+    sel_slot: int
+    input_slots: list[int]
+    table_fixed_idx: list[int]
+    pad: list[int]
+
+
+@dataclass
 class VerifyingKey:
     k: int
     ext_factor: int
@@ -375,6 +391,7 @@ class VerifyingKey:
     fixed_commits: list[G1]
     sigma_commits: list[G1]
     srs: Setup
+    lookups: list[LookupSpec] = dc_field(default_factory=list)
     digest: int = 0
 
     @property
@@ -400,6 +417,13 @@ class VerifyingKey:
             t.write_point(c)
         for tag in self.perm_tags:
             t.write_scalar(tag)
+        t.write_scalar(len(self.lookups))
+        for lk in self.lookups:
+            t.write_scalar(lk.sel_slot)
+            for s in lk.input_slots:
+                t.write_scalar(s)
+            for v in lk.pad:
+                t.write_scalar(v)
         return t.squeeze_challenge()
 
 
@@ -439,11 +463,13 @@ def compile_circuit(
     advice, instance, fixed = _classify_columns(cs)
     sel_names = sorted(cs.selectors)
 
-    min_k = max(2, (cs.n_rows + 1 - 1).bit_length())
+    max_table = max((len(lk.table) for lk in cs.lookups), default=0)
+    required = max(cs.n_rows + 1, max_table + 1, 4)
+    min_k = (required - 1).bit_length()
     if k is None:
         k = min_k
-    assert (1 << k) >= cs.n_rows + 1, f"k={k} too small for {cs.n_rows} rows"
     n = 1 << k
+    assert n >= required, f"k={k} too small for {cs.n_rows} rows / {max_table} table"
     assert k + 4 <= TWO_ADICITY
 
     # Slot assignment: advice, instance, fixed, then selector columns.
@@ -484,10 +510,44 @@ def compile_circuit(
         for sym in cons:
             used |= sym.used_cols()
             max_deg = max(max_deg, sym.deg + 1)  # +1 boolean selector
-    if cs.lookups:
-        raise NotImplementedError(
-            "lookup arguments are not yet supported by the PLONK backend"
+
+    # Lookup arguments: materialize each (sorted, padded) table as
+    # synthetic fixed columns; inputs/tables are theta-compressed at
+    # prove time inside the constraints.
+    lookup_specs: list[LookupSpec] = []
+    lookup_tables: list[list[list[int]]] = []  # per lookup: per element, n values
+    for lk in cs.lookups:
+        width = len(lk.columns)
+        entries = sorted(
+            (e if isinstance(e, tuple) else (e,)) for e in lk.table
         )
+        assert entries, f"lookup {lk.name}: empty table"
+        assert all(len(e) == width for e in entries), "table tuple width mismatch"
+        assert len(entries) <= n - 1, "lookup table exceeds usable rows"
+        pad = [v % R for v in entries[0]]
+        padded = entries + [tuple(pad)] * (n - len(entries))
+        cols_vals = [[int(e[j]) % R for e in padded] for j in range(width)]
+        table_idx = []
+        for j in range(width):
+            table_idx.append(len(names_fix))
+            names_fix.append(f"__lt{len(lookup_specs)}_{j}")
+        spec = LookupSpec(
+            name=lk.name,
+            sel_slot=sel_slot[lk.selector],
+            input_slots=[slot_of_col[c] for c in lk.columns],
+            table_fixed_idx=table_idx,
+            pad=pad,
+        )
+        lookup_specs.append(spec)
+        lookup_tables.append(cols_vals)
+        used.add((spec.sel_slot, 0))
+        for s in spec.input_slots:
+            used.add((s, 0))
+        for ti in table_idx:
+            slot = len(advice) + len(instance) + ti
+            slot_of_name[names_fix[ti]] = slot
+            used.add((slot, 0))
+        max_deg = max(max_deg, 5)  # grand-product constraint degree
 
     # Permutation: columns appearing in copy constraints.
     perm_cols: list[Column] = []
@@ -530,6 +590,9 @@ def compile_circuit(
         for row in cs.selectors[sname]:
             vals[row] = 1
         fixed_values.append(vals)
+    for cols_vals in lookup_tables:
+        fixed_values.extend(cols_vals)
+    assert len(fixed_values) == len(names_fix)
     fixed_polys = [domain.ifft(v) for v in fixed_values]
 
     # Permutation mapping sigma: identity tags, then rewire cycles.
@@ -597,6 +660,7 @@ def compile_circuit(
         fixed_commits=fixed_commits,
         sigma_commits=sigma_commits,
         srs=srs,
+        lookups=lookup_specs,
     )
     vk.digest = vk.compute_digest()
     return ProvingKey(
@@ -654,6 +718,76 @@ def _perm_constraints(
     return cons
 
 
+def _theta_compress(values, theta: int):
+    """Σ theta^j · v_j — THE tuple compression for lookups, shared by
+    prover and verifier (ints in, int out; Syms in, Sym out)."""
+    acc = None
+    th = 1
+    for v in values:
+        term = Sym.const(th) * v if isinstance(v, Sym) else th * (v % R) % R
+        acc = term if acc is None else acc + term
+        th = th * theta % R
+    if acc is None:
+        return 0
+    return acc if isinstance(acc, Sym) else acc % R
+
+
+def _lookup_constraints(
+    vk: VerifyingKey,
+    theta: int,
+    beta: int,
+    gamma: int,
+    lk_a_slots: list[int],
+    lk_s_slots: list[int],
+    lk_z_slots: list[int],
+    l0_slot: int,
+    llast_slot: int,
+    n_adv_inst: int,
+) -> list[Sym]:
+    """The lookup argument's constraints (shared prover/verifier):
+
+    for each lookup, with A the selector-gated theta-compressed input,
+    T the theta-compressed table, A'/S' the committed permutations and
+    Z the grand product over the n-1 active rows:
+
+      l_0·(Z−1);  l_last·(Z−1);
+      (1−l_last)·[Z(ωX)(A'+β)(S'+γ) − Z(X)(A+β)(T+γ)];
+      l_0·(A'−S');  (1−l_last)·(A'−S')(A'−A'(ω⁻¹X))
+    """
+    cons: list[Sym] = []
+    if not vk.lookups:
+        return cons
+    one = Sym.const(1)
+    l0 = Sym.col(l0_slot)
+    llast = Sym.col(llast_slot)
+    for i, lk in enumerate(vk.lookups):
+        sel = Sym.col(lk.sel_slot)
+        # A = sel·(compressed − pad) + pad
+        comp = _theta_compress([Sym.col(s) for s in lk.input_slots], theta)
+        padc = _theta_compress(lk.pad, theta)
+        a_expr = sel * (comp - Sym.const(padc)) + Sym.const(padc)
+        t_expr = _theta_compress(
+            [Sym.col(n_adv_inst + ti) for ti in lk.table_fixed_idx], theta
+        )
+        ap, sp_, z = (
+            Sym.col(lk_a_slots[i]),
+            Sym.col(lk_s_slots[i]),
+            Sym.col(lk_z_slots[i]),
+        )
+        z_next = Sym.col(lk_z_slots[i], 1)
+        ap_prev = Sym.col(lk_a_slots[i], -1)
+        b, g = Sym.const(beta), Sym.const(gamma)
+        cons.append(l0 * (z - one))
+        cons.append(llast * (z - one))
+        cons.append(
+            (one - llast)
+            * (z_next * ((ap + b) * (sp_ + g)) - z * ((a_expr + b) * (t_expr + g)))
+        )
+        cons.append(l0 * (ap - sp_))
+        cons.append((one - llast) * (ap - sp_) * (ap - ap_prev))
+    return cons
+
+
 def _opening_entries(vk: VerifyingKey, n_t: int):
     """Deterministic list of (kind, index, rots) for every opened
     polynomial: advice, fixed (incl. selectors), sigma, z, t-chunks."""
@@ -677,6 +811,10 @@ def _opening_entries(vk: VerifyingKey, n_t: int):
         if c < n_chunks - 1:
             rots = [-1, 0, 1]
         entries.append(("z", c, tuple(rots)))
+    for i in range(len(vk.lookups)):
+        entries.append(("lkA", i, (-1, 0)))
+        entries.append(("lkS", i, (0,)))
+        entries.append(("lkZ", i, (0, 1)))
     for c in range(n_t):
         entries.append(("t", c, (0,)))
     return entries
@@ -856,14 +994,6 @@ def prove(
         for v in inst_map[name]:
             transcript.common_scalar(v)
 
-    # Round 1: advice commitments (opened at ≤2 rotations; 3 blinders).
-    advice_polys = [blind(domain.ifft(v), 3) for v in advice_values]
-    for p in advice_polys:
-        transcript.write_point(srs.commit(p))
-    beta = transcript.squeeze_challenge()
-    gamma = transcript.squeeze_challenge()
-
-    # Round 2: permutation grand products.
     slot_values: dict[int, list[int]] = {}
     n_adv, n_inst = len(advice), len(instance_cols)
     for i, vals in enumerate(advice_values):
@@ -872,6 +1002,71 @@ def prove(
         slot_values[n_adv + i] = vals
     for i, vals in enumerate(pk.fixed_values):
         slot_values[n_adv + n_inst + i] = vals
+
+    # Round 1: advice commitments (opened at ≤2 rotations; 3 blinders).
+    advice_polys = [blind(domain.ifft(v), 3) for v in advice_values]
+    for p in advice_polys:
+        transcript.write_point(srs.commit(p))
+
+    # Round 1.5: lookup permutations (Halo2 ordering: theta after
+    # advice, A'/S' commitments before beta/gamma).
+    theta = transcript.squeeze_challenge() if vk.lookups else 0
+    lk_a_vals: list[list[int]] = []  # compressed selector-gated inputs
+    lk_t_vals: list[list[int]] = []  # compressed table
+    lk_ap_vals: list[list[int]] = []  # A' (sorted input)
+    lk_sp_vals: list[list[int]] = []  # S' (table permutation)
+    lk_ap_polys: list[list[int]] = []
+    lk_sp_polys: list[list[int]] = []
+    for lk in vk.lookups:
+        sel_vals = slot_values[lk.sel_slot]
+        padc = _theta_compress(lk.pad, theta)
+        a_comp = [
+            _theta_compress([slot_values[s][i] for s in lk.input_slots], theta)
+            if sel_vals[i]
+            else padc
+            for i in range(n)
+        ]
+        t_comp = [
+            _theta_compress(
+                [pk.fixed_values[ti][i] for ti in lk.table_fixed_idx], theta
+            )
+            for i in range(n)
+        ]
+        # Sort the active rows; build S' giving each first occurrence
+        # its table copy.
+        from collections import Counter
+
+        a_sorted = sorted(a_comp[: n - 1])
+        remaining = Counter(t_comp[: n - 1])
+        s_prime = [None] * (n - 1)
+        fill_rows = []
+        for i, val in enumerate(a_sorted):
+            if i == 0 or val != a_sorted[i - 1]:
+                if remaining[val] <= 0:
+                    raise AssertionError(
+                        f"lookup {lk.name}: input {val:#x} not in table"
+                    )
+                remaining[val] -= 1
+                s_prime[i] = val
+            else:
+                fill_rows.append(i)
+        leftovers = [v for v, c in sorted(remaining.items()) for _ in range(c)]
+        assert len(leftovers) == len(fill_rows)
+        for i, v in zip(fill_rows, leftovers):
+            s_prime[i] = v
+        lk_a_vals.append(a_comp)
+        lk_t_vals.append(t_comp)
+        lk_ap_vals.append(a_sorted + [0])
+        lk_sp_vals.append(list(s_prime) + [0])
+        ap_poly = blind(domain.ifft(a_sorted + [0]), 3)
+        sp_poly = blind(domain.ifft(list(s_prime) + [0]), 3)
+        lk_ap_polys.append(ap_poly)
+        lk_sp_polys.append(sp_poly)
+        transcript.write_point(srs.commit(ap_poly))
+        transcript.write_point(srs.commit(sp_poly))
+
+    beta = transcript.squeeze_challenge()
+    gamma = transcript.squeeze_challenge()
 
     z_polys: list[list[int]] = []
     z_values: list[list[int]] = []
@@ -898,6 +1093,24 @@ def prove(
         assert start == 1, "permutation product != 1 (copy constraints broken?)"
     for p in z_polys:
         transcript.write_point(srs.commit(p))
+
+    # Lookup grand products Z_i over the active rows.
+    lk_z_polys: list[list[int]] = []
+    for li in range(len(vk.lookups)):
+        a_comp, t_comp = lk_a_vals[li], lk_t_vals[li]
+        ap, sp_ = lk_ap_vals[li], lk_sp_vals[li]
+        dens = [
+            (ap[i] + beta) % R * ((sp_[i] + gamma) % R) % R for i in range(n - 1)
+        ]
+        den_inv = _batch_inv(dens)
+        z = [0] * n
+        z[0] = 1
+        for i in range(n - 1):
+            num = (a_comp[i] + beta) % R * ((t_comp[i] + gamma) % R) % R
+            z[i + 1] = z[i] * num % R * den_inv[i] % R
+        assert z[n - 1] == 1, "lookup product != 1 (input not a table subset?)"
+        lk_z_polys.append(blind(domain.ifft(z), 3))
+        transcript.write_point(srs.commit(lk_z_polys[-1]))
     y = transcript.squeeze_challenge()
 
     # Round 3: quotient.
@@ -908,6 +1121,10 @@ def prove(
     z_slots = [base_slots + len(sigma_slots) + c for c in range(len(vk.chunks))]
     x_slot = base_slots + len(sigma_slots) + len(z_slots)
     l0_slot, llast_slot = x_slot + 1, x_slot + 2
+    n_lk = len(vk.lookups)
+    lk_a_slots = [llast_slot + 1 + i for i in range(n_lk)]
+    lk_s_slots = [llast_slot + 1 + n_lk + i for i in range(n_lk)]
+    lk_z_slots = [llast_slot + 1 + 2 * n_lk + i for i in range(n_lk)]
 
     for i, p in enumerate(advice_polys):
         ev.set_coeffs(i, p)
@@ -928,6 +1145,10 @@ def prove(
     elast[n - 1] = 1
     ev.set_coeffs(l0_slot, domain.ifft(e0))
     ev.set_coeffs(llast_slot, domain.ifft(elast))
+    for i in range(n_lk):
+        ev.set_coeffs(lk_a_slots[i], lk_ap_polys[i])
+        ev.set_coeffs(lk_s_slots[i], lk_sp_polys[i])
+        ev.set_coeffs(lk_z_slots[i], lk_z_polys[i])
 
     # y-combined constraint programs: one per gate, then permutation.
     programs: list[Sym] = []
@@ -941,6 +1162,20 @@ def prove(
         programs.append(Sym.col(spec.sel_slot) * combined)
     for con in _perm_constraints(
         vk, beta, gamma, z_slots, sigma_slots, x_slot, l0_slot, llast_slot
+    ):
+        programs.append(Sym.const(pow(y, y_pow, R)) * con)
+        y_pow += 1
+    for con in _lookup_constraints(
+        vk,
+        theta,
+        beta,
+        gamma,
+        lk_a_slots,
+        lk_s_slots,
+        lk_z_slots,
+        l0_slot,
+        llast_slot,
+        n_adv + n_inst,
     ):
         programs.append(Sym.const(pow(y, y_pow, R)) * con)
         y_pow += 1
@@ -1001,6 +1236,12 @@ def prove(
             return pk.sigma_polys[idx]
         if kind == "z":
             return z_polys[idx]
+        if kind == "lkA":
+            return lk_ap_polys[idx]
+        if kind == "lkS":
+            return lk_sp_polys[idx]
+        if kind == "lkZ":
+            return lk_z_polys[idx]
         return t_chunks[idx]
 
     evals: dict[tuple[str, int, int], int] = {}
@@ -1087,9 +1328,15 @@ def _verify_inner(vk, instances, proof) -> bool:
             t.common_scalar(v)
 
     advice_commits = [t.read_point() for _ in vk.advice_names]
+    theta = t.squeeze_challenge() if vk.lookups else 0
+    lk_ap_commits, lk_sp_commits = [], []
+    for _ in vk.lookups:
+        lk_ap_commits.append(t.read_point())
+        lk_sp_commits.append(t.read_point())
     beta = t.squeeze_challenge()
     gamma = t.squeeze_challenge()
     z_commits = [t.read_point() for _ in vk.chunks]
+    lk_z_commits = [t.read_point() for _ in vk.lookups]
     y = t.squeeze_challenge()
 
     # t-chunk count is bounded by the extension factor (plus blinding
@@ -1134,6 +1381,10 @@ def _verify_inner(vk, instances, proof) -> bool:
     z_slots = [base_slots + len(sigma_slots) + c for c in range(len(vk.chunks))]
     x_slot = base_slots + len(sigma_slots) + len(z_slots)
     l0_slot, llast_slot = x_slot + 1, x_slot + 2
+    n_lk = len(vk.lookups)
+    lk_a_slots = [llast_slot + 1 + i for i in range(n_lk)]
+    lk_s_slots = [llast_slot + 1 + n_lk + i for i in range(n_lk)]
+    lk_z_slots = [llast_slot + 1 + 2 * n_lk + i for i in range(n_lk)]
 
     zh = (pow(x, n, R) - 1) % R
     n_inv = pow(n, R - 2, R)
@@ -1165,6 +1416,12 @@ def _verify_inner(vk, instances, proof) -> bool:
             return evals[("fixed", slot - n_adv - n_inst, rot)]
         if slot in sigma_slots:
             return evals[("sigma", slot - base_slots, rot)]
+        if slot in lk_a_slots:
+            return evals[("lkA", lk_a_slots.index(slot), rot)]
+        if slot in lk_s_slots:
+            return evals[("lkS", lk_s_slots.index(slot), rot)]
+        if slot in lk_z_slots:
+            return evals[("lkZ", lk_z_slots.index(slot), rot)]
         c = z_slots.index(slot)
         return evals[("z", c, rot)]
 
@@ -1179,6 +1436,20 @@ def _verify_inner(vk, instances, proof) -> bool:
             y_pow += 1
     for con in _perm_constraints(
         vk, beta, gamma, z_slots, sigma_slots, x_slot, l0_slot, llast_slot
+    ):
+        combined = (combined + pow(y, y_pow, R) * sym_eval(con, getval, {})) % R
+        y_pow += 1
+    for con in _lookup_constraints(
+        vk,
+        theta,
+        beta,
+        gamma,
+        lk_a_slots,
+        lk_s_slots,
+        lk_z_slots,
+        l0_slot,
+        llast_slot,
+        n_adv + n_inst,
     ):
         combined = (combined + pow(y, y_pow, R) * sym_eval(con, getval, {})) % R
         y_pow += 1
@@ -1200,6 +1471,12 @@ def _verify_inner(vk, instances, proof) -> bool:
             return vk.sigma_commits[idx]
         if kind == "z":
             return z_commits[idx]
+        if kind == "lkA":
+            return lk_ap_commits[idx]
+        if kind == "lkS":
+            return lk_sp_commits[idx]
+        if kind == "lkZ":
+            return lk_z_commits[idx]
         return t_commits[idx]
 
     from .fields import pairing_check

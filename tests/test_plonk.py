@@ -160,6 +160,72 @@ class TestPlonkPoseidon:
         assert not plonk.verify(pk.vk, [0b101100], proof)
 
 
+class TestPlonkLookup:
+    """The lookup argument (Halo2-style A'/S' + grand product) proving
+    RangeCheckChip circuits under the real SNARK."""
+
+    def _range_circuit(self):
+        from protocol_tpu.zk.chips import RangeCheckChip
+
+        cs = ConstraintSystem()
+        std = StdGate(cs)
+        rng = RangeCheckChip(cs, word_bits=4)
+        x = std.witness(13)
+        rng.assert_word(x)
+        y = std.witness(200)
+        rng.assert_range(y, 2)
+        inst = cs.column("instance", "instance")
+        cs.copy(cs.assign(inst, 0, 13), x)
+        cs.assert_satisfied()
+        return cs
+
+    def test_range_lookup_roundtrip(self):
+        cs = self._range_circuit()
+        pk = plonk.compile_circuit(cs)
+        assert len(pk.vk.lookups) == 1
+        proof = plonk.prove(pk, cs, [13], seed=b"lk")
+        assert plonk.verify(pk.vk, [13], proof)
+        assert not plonk.verify(pk.vk, [14], proof)
+        bad = bytearray(proof)
+        bad[100] ^= 1
+        assert not plonk.verify(pk.vk, [13], bytes(bad))
+
+    def test_out_of_table_witness_unprovable(self):
+        from protocol_tpu.zk.chips import RangeCheckChip
+
+        cs = self._range_circuit()
+        pk = plonk.compile_circuit(cs)
+        cs2 = ConstraintSystem()
+        std2 = StdGate(cs2)
+        rng2 = RangeCheckChip(cs2, word_bits=4)
+        x2 = std2.witness(21)  # 21 >= 16: not in the 4-bit table
+        r = cs2.alloc_rows(1)
+        cs2.copy(cs2.assign(rng2.word, r, 21), x2)
+        cs2.enable(rng2._sel_word, r)
+        y2 = std2.witness(200)
+        rng2.assert_range(y2, 2)
+        cs2.copy(cs2.assign(cs2.column("instance", "instance"), 0, 21), x2)
+        with pytest.raises(AssertionError, match="not in table"):
+            plonk.prove(pk, cs2, [21], seed=b"bad")
+
+    def test_table_forces_domain_growth(self):
+        """A 2^8 table in a tiny circuit still compiles (k grows to fit
+        the table rows)."""
+        from protocol_tpu.zk.chips import RangeCheckChip
+
+        cs = ConstraintSystem()
+        std = StdGate(cs)
+        rng = RangeCheckChip(cs, word_bits=8)
+        x = std.witness(250)
+        rng.assert_word(x)
+        inst = cs.column("instance", "instance")
+        cs.copy(cs.assign(inst, 0, 250), x)
+        pk = plonk.compile_circuit(cs)
+        assert pk.vk.n >= 257
+        proof = plonk.prove(pk, cs, [250], seed=b"t8")
+        assert plonk.verify(pk.vk, [250], proof)
+
+
 class TestDomain:
     def test_fft_roundtrip(self):
         d = plonk.Domain(5)
