@@ -37,6 +37,7 @@ behavioral parity targets only.
 from __future__ import annotations
 
 import secrets
+from collections import Counter
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
@@ -419,9 +420,14 @@ class VerifyingKey:
             t.write_scalar(tag)
         t.write_scalar(len(self.lookups))
         for lk in self.lookups:
+            # Width delimiter first: without it, adjacent lookups'
+            # variable-length field sequences concatenate ambiguously.
+            t.write_scalar(len(lk.input_slots))
             t.write_scalar(lk.sel_slot)
             for s in lk.input_slots:
                 t.write_scalar(s)
+            for ti in lk.table_fixed_idx:
+                t.write_scalar(ti)
             for v in lk.pad:
                 t.write_scalar(v)
         return t.squeeze_challenge()
@@ -1034,8 +1040,6 @@ def prove(
         ]
         # Sort the active rows; build S' giving each first occurrence
         # its table copy.
-        from collections import Counter
-
         a_sorted = sorted(a_comp[: n - 1])
         remaining = Counter(t_comp[: n - 1])
         s_prime = [None] * (n - 1)

@@ -1,0 +1,226 @@
+"""EVM interpreter tests: opcode semantics, gas accounting sanity,
+Bn254 precompiles, and a wrapper-style staticcall flow (the execution
+profile the reference exercises through revm, verifier/mod.rs:117-134)."""
+
+import pytest
+
+from protocol_tpu.crypto.keccak import keccak256
+from protocol_tpu.evm import EVM, Precompiles, asm
+from protocol_tpu.zk.bn254 import G1, GENERATOR
+from protocol_tpu.zk.fields import G2_GENERATOR
+
+FQ = 21888242871839275222246405745257275088696311157297823662689037894645226208583
+FR = 21888242871839275222246405745257275088548364400416034343698204186575808495617
+
+
+def run(code: bytes, calldata: bytes = b"", gas: int = 10_000_000):
+    evm = EVM()
+    addr = evm.deploy_runtime(code)
+    return evm.call(addr, calldata, gas)
+
+
+def ret_word(*pre) -> tuple:
+    """asm suffix: store top of stack at mem[0] and return 32 bytes."""
+    return (*pre, 0, "MSTORE", 32, 0, "RETURN")
+
+
+class TestInterpreter:
+    def test_arithmetic(self):
+        r = run(asm(*ret_word(7, 5, "ADD")))
+        assert r.success and int.from_bytes(r.returndata, "big") == 12
+
+    def test_mulmod(self):
+        r = run(asm(*ret_word(FR, 3, FR - 1, "MULMOD")))
+        # (FR-1)*3 mod FR = FR-3
+        assert int.from_bytes(r.returndata, "big") == FR - 3
+
+    def test_sub_order(self):
+        r = run(asm(*ret_word(3, 10, "SUB")))
+        assert int.from_bytes(r.returndata, "big") == 7  # 10 - 3
+
+    def test_calldataload(self):
+        r = run(
+            asm(*ret_word(0, "CALLDATALOAD")),
+            calldata=(99).to_bytes(32, "big"),
+        )
+        assert int.from_bytes(r.returndata, "big") == 99
+
+    def test_keccak(self):
+        r = run(asm(0xAB, 0, "MSTORE8", 1, 0, "KECCAK256", 0, "MSTORE", 32, 0, "RETURN"))
+        assert r.returndata == keccak256(b"\xab")
+
+    def test_jump_loop(self):
+        # sum 1..5 with a loop: i in slot counter on stack
+        code = asm(
+            0,  # acc
+            5,  # i
+            ("label", "loop"),
+            "DUP1",
+            "ISZERO",
+            ("ref", "end"),
+            "JUMPI",  # if i == 0 goto end
+            "DUP1",  # [acc, i, i]
+            "SWAP2",  # [i, i, acc]
+            "ADD",  # [i, acc+i]
+            "SWAP1",  # [acc', i]
+            1,
+            "SWAP1",
+            "SUB",  # [acc', i-1]
+            ("ref", "loop"),
+            "JUMP",
+            ("label", "end"),
+            "POP",
+            0,
+            "MSTORE",
+            32,
+            0,
+            "RETURN",
+        )
+        r = run(code)
+        assert r.success, r.error
+        assert int.from_bytes(r.returndata, "big") == 15
+
+    def test_revert_propagates(self):
+        r = run(asm(0, 0, "REVERT"))
+        assert not r.success and r.error == "revert"
+
+    def test_out_of_gas(self):
+        r = run(asm(*ret_word(7, 5, "ADD")), gas=4)
+        assert not r.success and "gas" in r.error
+
+    def test_bad_jump_rejected(self):
+        r = run(asm(4, "JUMP", "STOP", "STOP"))
+        assert not r.success and "jump" in r.error
+
+    def test_gas_metered(self):
+        r = run(asm(*ret_word(7, 5, "ADD")))
+        assert 0 < r.gas_used < 100
+
+
+class TestPrecompiles:
+    def test_ec_add(self):
+        g2 = GENERATOR.double()
+        data = (
+            GENERATOR.x.to_bytes(32, "big")
+            + GENERATOR.y.to_bytes(32, "big")
+            + GENERATOR.x.to_bytes(32, "big")
+            + GENERATOR.y.to_bytes(32, "big")
+        )
+        ok, out, gas = Precompiles.run(0x06, data)
+        assert ok and gas == 150
+        assert int.from_bytes(out[:32], "big") == g2.x
+        assert int.from_bytes(out[32:], "big") == g2.y
+
+    def test_ec_mul(self):
+        data = (
+            GENERATOR.x.to_bytes(32, "big")
+            + GENERATOR.y.to_bytes(32, "big")
+            + (5).to_bytes(32, "big")
+        )
+        ok, out, gas = Precompiles.run(0x07, data)
+        g5 = GENERATOR.mul(5)
+        assert ok and int.from_bytes(out[:32], "big") == g5.x
+
+    def test_ec_add_rejects_off_curve(self):
+        data = (1).to_bytes(32, "big") + (1).to_bytes(32, "big") + bytes(64)
+        ok, _, _ = Precompiles.run(0x06, data)
+        assert not ok
+
+    def test_modexp_inverse(self):
+        # a^(FR-2) mod FR == a^-1
+        a = 12345
+        data = (
+            (32).to_bytes(32, "big") * 3
+            + a.to_bytes(32, "big")
+            + (FR - 2).to_bytes(32, "big")
+            + FR.to_bytes(32, "big")
+        )
+        ok, out, gas = Precompiles.run(0x05, data)
+        assert ok
+        inv = int.from_bytes(out, "big")
+        assert a * inv % FR == 1
+
+    def test_pairing_check_via_evm(self):
+        """e(G, H)·e(−G, H) == 1 through the 0x08 precompile."""
+
+        def g2_words(q):
+            return (
+                q.x.coeffs[1].to_bytes(32, "big")
+                + q.x.coeffs[0].to_bytes(32, "big")
+                + q.y.coeffs[1].to_bytes(32, "big")
+                + q.y.coeffs[0].to_bytes(32, "big")
+            )
+
+        neg_g = GENERATOR.neg()
+        data = (
+            GENERATOR.x.to_bytes(32, "big")
+            + GENERATOR.y.to_bytes(32, "big")
+            + g2_words(G2_GENERATOR)
+            + neg_g.x.to_bytes(32, "big")
+            + neg_g.y.to_bytes(32, "big")
+            + g2_words(G2_GENERATOR)
+        )
+        ok, out, gas = Precompiles.run(0x08, data)
+        assert ok and int.from_bytes(out, "big") == 1
+        assert gas == 45000 + 34000 * 2
+
+    def test_pairing_nondegenerate(self):
+        """e(G, H) != 1 alone."""
+
+        def g2_words(q):
+            return (
+                q.x.coeffs[1].to_bytes(32, "big")
+                + q.x.coeffs[0].to_bytes(32, "big")
+                + q.y.coeffs[1].to_bytes(32, "big")
+                + q.y.coeffs[0].to_bytes(32, "big")
+            )
+
+        data = (
+            GENERATOR.x.to_bytes(32, "big")
+            + GENERATOR.y.to_bytes(32, "big")
+            + g2_words(G2_GENERATOR)
+        )
+        ok, out, _ = Precompiles.run(0x08, data)
+        assert ok and int.from_bytes(out, "big") == 0
+
+
+class TestStaticcallFlow:
+    def test_wrapper_staticcalls_inner(self):
+        """EtVerifierWrapper-style: outer contract forwards calldata to
+        an inner contract via STATICCALL and returns its result."""
+        evm = EVM()
+        # Inner: returns calldata[0..32] + 1.
+        inner = evm.deploy_runtime(
+            asm(*ret_word(1, 0, "CALLDATALOAD", "ADD"))
+        )
+        # Outer: copy calldata to memory, staticcall inner, return its word.
+        outer = evm.deploy_runtime(
+            asm(
+                "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+                32, 0, "CALLDATASIZE", 0, inner, "GAS", "STATICCALL",
+                ("ref", "ok"), "JUMPI",
+                0, 0, "REVERT",
+                ("label", "ok"),
+                32, 0, "RETURN",
+            )
+        )
+        r = evm.call(outer, (41).to_bytes(32, "big"))
+        assert r.success, r.error
+        assert int.from_bytes(r.returndata, "big") == 42
+
+    def test_staticcall_precompile_from_bytecode(self):
+        """ecMul via STATICCALL from inside a contract."""
+        code = asm(
+            # mem[0:64] = G, mem[64] = 3
+            GENERATOR.x, 0, "MSTORE",
+            GENERATOR.y, 32, "MSTORE",
+            3, 64, "MSTORE",
+            # staticcall(gas, 0x07, 0, 96, 0, 64)
+            64, 0, 96, 0, 0x07, "GAS", "STATICCALL",
+            "POP",
+            64, 0, "RETURN",
+        )
+        r = run(code)
+        assert r.success, r.error
+        g3 = GENERATOR.mul(3)
+        assert int.from_bytes(r.returndata[:32], "big") == g3.x
