@@ -60,6 +60,9 @@ class ClientConfig:
     ethereum_node_url: str
     server_url: str
     event_fixture: str | None = None
+    #: Path to the generated EVM verifier artifact (data/et_verifier.bin
+    #: analog); enables local contract-level verification.
+    et_verifier_bin: str | None = None
 
     @classmethod
     def from_json(cls, text: str) -> "ClientConfig":
@@ -73,6 +76,7 @@ class ClientConfig:
             ethereum_node_url=obj["ethereum_node_url"],
             server_url=obj["server_url"],
             event_fixture=obj.get("event_fixture"),
+            et_verifier_bin=obj.get("et_verifier_bin"),
         )
 
     def to_json(self) -> str:
@@ -87,6 +91,8 @@ class ClientConfig:
         }
         if self.event_fixture:
             out["event_fixture"] = self.event_fixture
+        if self.et_verifier_bin:
+            out["et_verifier_bin"] = self.et_verifier_bin
         return json.dumps(out, indent=4)
 
     @classmethod
@@ -178,14 +184,37 @@ class EigenTrustClient:
 
     def verify(self, proof_raw: ProofRaw) -> bool:
         """Verify the fetched proof: on-chain via the EtVerifierWrapper
-        in chain mode (client/src/lib.rs:122-149), otherwise locally
-        with the framework prover."""
+        in chain mode (client/src/lib.rs:122-149); otherwise locally —
+        through the in-process EVM when an et_verifier.bin artifact is
+        available (the reference's contract-level verification,
+        verifier/mod.rs:117-134), or with the commitment prover for
+        commitment-backend nodes."""
         if self.use_chain():
             return self._verify_web3(proof_raw)
         proof = proof_raw.to_proof()
-        from ..zk.proof import PoseidonCommitmentProver
+        # Commitment-backend proofs are 32-byte digest + JSON payload;
+        # dispatch on shape, not on what files happen to exist in CWD.
+        if proof.proof[32:33] == b"{":
+            from ..zk.proof import PoseidonCommitmentProver
 
-        return PoseidonCommitmentProver().verify(proof.pub_ins, proof.proof)
+            return PoseidonCommitmentProver().verify(proof.pub_ins, proof.proof)
+        from ..zk.evm_verifier import evm_verify
+
+        ok, _gas = evm_verify(self._verifier_artifact(), proof.pub_ins, proof.proof)
+        return ok
+
+    def _verifier_artifact(self):
+        """Load the EVM verifier artifact; a configured path that does
+        not exist is a deployment error, not a silent fallback."""
+        from ..zk.evm_verifier import GeneratedVerifier
+
+        path = Path(self.config.et_verifier_bin or "data/et_verifier.bin")
+        if not path.exists():
+            raise ClientError(
+                f"SNARK proof received but verifier artifact {path} is missing "
+                "(generate it with tools/gen_et_verifier.py)"
+            )
+        return GeneratedVerifier.from_bytes(path.read_bytes())
 
     def _verify_web3(self, proof_raw: ProofRaw) -> bool:
         """Transact EtVerifierWrapper.verify(uint256[5], bytes)

@@ -50,7 +50,7 @@ _OPCODES = {
     "ADDRESS": 0x30, "CALLER": 0x33, "CALLVALUE": 0x34,
     "CALLDATALOAD": 0x35, "CALLDATASIZE": 0x36, "CALLDATACOPY": 0x37,
     "CODESIZE": 0x38, "CODECOPY": 0x39,
-    "RETURNDATASIZE": 0x3D, "RETURNDATACOPY": 0x3E,
+    "RETURNDATASIZE": 0x3D, "RETURNDATACOPY": 0x3E, "EXTCODESIZE": 0x3B,
     "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52, "MSTORE8": 0x53,
     "SLOAD": 0x54, "SSTORE": 0x55, "JUMP": 0x56, "JUMPI": 0x57,
     "PC": 0x58, "MSIZE": 0x59, "GAS": 0x5A, "JUMPDEST": 0x5B,
@@ -85,7 +85,7 @@ def asm(*items) -> bytes:
             pos += 1
         elif isinstance(it, tuple) and it[0] == "ref":
             code.append(it)
-            pos += 3  # PUSH2 + 2 bytes
+            pos += 4  # PUSH3 + 3 bytes (verifier contracts exceed 64KB)
         elif isinstance(it, str):
             code.append(("op", _OPCODES[it]))
             pos += 1
@@ -113,8 +113,8 @@ def asm(*items) -> bytes:
         elif it[0] == "raw":
             out += it[1]
         else:  # ref
-            out.append(0x61)  # PUSH2
-            out += labels[it[1]].to_bytes(2, "big")
+            out.append(0x62)  # PUSH3
+            out += labels[it[1]].to_bytes(3, "big")
     return bytes(out)
 
 
@@ -354,6 +354,7 @@ class EVM:
                     0x20,
                     0x37,
                     0x39,
+                    0x3B,
                     0x3E,
                     0xFA,
                 ):
@@ -460,6 +461,9 @@ class EVM:
                     dst, src, size = pop(), pop(), pop()
                     use(3 + 3 * ((size + 31) // 32))
                     mwrite(dst, code[src : src + size].ljust(size, b"\0"))
+                elif opcode == 0x3B:  # EXTCODESIZE
+                    use(700)
+                    push(len(self.code.get(pop(), b"")))
                 elif opcode == 0x3D:
                     push(len(ret_buf))
                 elif opcode == 0x3E:  # RETURNDATACOPY

@@ -48,7 +48,15 @@ from ..utils.limbs import from_limbs_fast, ptr as _ptr, to_limbs, to_limbs_fast
 from .bn254 import G1, GENERATOR
 from .cs import Column, ConstraintSystem
 from .kzg import Setup, _div_by_linear, _eval_poly, msm
-from .transcript import PoseidonRead, PoseidonWrite
+from .transcript import KeccakRead, KeccakWrite, PoseidonRead, PoseidonWrite
+
+#: Fiat-Shamir backends: "poseidon" (native flow, aggregation-friendly)
+#: and "keccak" (EVM flow — replayable with the KECCAK256 opcode, the
+#: snark-verifier EvmTranscript analog).
+_TRANSCRIPTS = {
+    "poseidon": (PoseidonWrite, PoseidonRead),
+    "keccak": (KeccakWrite, KeccakRead),
+}
 
 R = field.MODULUS
 TWO_ADICITY = 28
@@ -715,8 +723,8 @@ def _perm_constraints(
         den = one
         for j in chunk:
             v = Sym.col(vk.perm_slots[j])
-            num = num * (v + Sym.const(beta * vk.perm_tags[j] % R) * x + Sym.const(gamma))
-            den = den * (v + Sym.const(beta) * Sym.col(sigma_slots[j]) + Sym.const(gamma))
+            num = num * (v + _c(beta * vk.perm_tags[j] % R) * x + _c(gamma))
+            den = den * (v + _c(beta) * Sym.col(sigma_slots[j]) + _c(gamma))
         z, z_next = Sym.col(z_slots[c]), Sym.col(z_slots[c], 1)
         cons.append((one - llast) * (z_next * den - z * num))
     # Total product is 1.
@@ -724,13 +732,24 @@ def _perm_constraints(
     return cons
 
 
-def _theta_compress(values, theta: int):
+def _c(v) -> Sym:
+    """Wrap a scalar as a constant symbol; pass symbols through —
+    challenges may arrive as ints (prover/verifier) or as runtime
+    symbols (the EVM verifier codegen), and the constraint builders
+    must produce identical structure either way."""
+    return v if isinstance(v, Sym) else Sym.const(v)
+
+
+def _theta_compress(values, theta):
     """Σ theta^j · v_j — THE tuple compression for lookups, shared by
     prover and verifier (ints in, int out; Syms in, Sym out)."""
     acc = None
     th = 1
     for v in values:
-        term = Sym.const(th) * v if isinstance(v, Sym) else th * (v % R) % R
+        if isinstance(v, Sym) or isinstance(th, Sym):
+            term = _c(th) * v
+        else:
+            term = th * (v % R) % R
         acc = term if acc is None else acc + term
         th = th * theta % R
     if acc is None:
@@ -771,7 +790,7 @@ def _lookup_constraints(
         # A = sel·(compressed − pad) + pad
         comp = _theta_compress([Sym.col(s) for s in lk.input_slots], theta)
         padc = _theta_compress(lk.pad, theta)
-        a_expr = sel * (comp - Sym.const(padc)) + Sym.const(padc)
+        a_expr = sel * (comp - _c(padc)) + _c(padc)
         t_expr = _theta_compress(
             [Sym.col(n_adv_inst + ti) for ti in lk.table_fixed_idx], theta
         )
@@ -782,7 +801,7 @@ def _lookup_constraints(
         )
         z_next = Sym.col(lk_z_slots[i], 1)
         ap_prev = Sym.col(lk_a_slots[i], -1)
-        b, g = Sym.const(beta), Sym.const(gamma)
+        b, g = _c(beta), _c(gamma)
         cons.append(l0 * (z - one))
         cons.append(llast * (z - one))
         cons.append(
@@ -949,6 +968,7 @@ def prove(
     cs: ConstraintSystem,
     instances: dict[str, list[int]] | list[int],
     seed: bytes | None = None,
+    transcript: str = "poseidon",
 ) -> bytes:
     """Produce a PLONK proof that ``cs``'s trace satisfies the compiled
     circuit with the given public inputs."""
@@ -994,7 +1014,7 @@ def prove(
         for c in instance_cols
     ]
 
-    transcript = PoseidonWrite()
+    transcript = _TRANSCRIPTS[transcript][0]()
     transcript.common_scalar(vk.digest)
     for name in vk.instance_names:
         for v in inst_map[name]:
@@ -1308,24 +1328,40 @@ def _canon_instances(
 # ---------------------------------------------------------------------------
 
 
+def quotient_chunks(vk: VerifyingKey, proof_len: int) -> int:
+    """Quotient-chunk count inferred from a proof's byte length — THE
+    shared inference (Python verifier and EVM codegen must agree)."""
+    pre_words = 2 * vk.n_advice + 6 * len(vk.lookups) + 2 * len(vk.chunks)
+    entries_fixed = _opening_entries(vk, 0)
+    n_evals_fixed = sum(len(rots) for _, _, rots in entries_fixed)
+    rot_set = {rot for _, _, rots in entries_fixed for rot in rots}
+    rot_set.add(0)
+    remaining = proof_len - 32 * pre_words
+    # Each t-chunk adds: 64 (commit) + 32 (eval). Fixed tail: evals + witnesses.
+    fixed_tail = n_evals_fixed * 32 + len(rot_set) * 64
+    return (remaining - fixed_tail) // 96
+
+
 def verify(
     vk: VerifyingKey,
     instances: dict[str, list[int]] | list[int],
     proof: bytes,
+    transcript: str = "poseidon",
 ) -> bool:
+    _TRANSCRIPTS[transcript]  # unknown backend name must raise, not "invalid proof"
     try:
-        return _verify_inner(vk, instances, proof)
+        return _verify_inner(vk, instances, proof, transcript)
     except (ValueError, AssertionError, IndexError, KeyError):
         return False
 
 
-def _verify_inner(vk, instances, proof) -> bool:
+def _verify_inner(vk, instances, proof, transcript: str = "poseidon") -> bool:
     k, n = vk.k, vk.n
     domain = Domain(k)
     w = domain.omega
     inst_map = _canon_instances(vk, instances)
 
-    t = PoseidonRead(proof)
+    t = _TRANSCRIPTS[transcript][1](proof)
     t.common_scalar(vk.digest)
     for name in vk.instance_names:
         for v in inst_map[name]:
@@ -1347,14 +1383,7 @@ def _verify_inner(vk, instances, proof) -> bool:
     # spill); read points until the count the prover committed.  The
     # count is recoverable because it is the only variable-length
     # section: infer from remaining length after fixing the rest.
-    entries_fixed = _opening_entries(vk, 0)
-    n_evals_fixed = sum(len(rots) for _, _, rots in entries_fixed)
-    rot_set = {rot for _, _, rots in entries_fixed for rot in rots}
-    rot_set.add(0)
-    remaining = len(proof) - t._off
-    # Each t-chunk adds: 64 (commit) + 32 (eval). Fixed tail: evals + witnesses.
-    fixed_tail = n_evals_fixed * 32 + len(rot_set) * 64
-    n_t = (remaining - fixed_tail) // 96
+    n_t = quotient_chunks(vk, len(proof))
     if n_t < 1 or n_t > 4 * vk.ext_factor:
         return False
     t_commits = [t.read_point() for _ in range(n_t)]

@@ -120,6 +120,7 @@ class PlonkEpochProver(Prover):
             for sk, pk, m, r in zip(sks, pks, messages, rows)
         ]
         pub = power_iterate([initial_score] * n, rows, num_iter, scale)
+        self._dummy_statement = (atts, pub)
         cs = prove_epoch_statement(atts, pub, **self._params)
         if srs is None and srs_path is not None:
             from pathlib import Path
@@ -133,6 +134,11 @@ class PlonkEpochProver(Prover):
     def vk(self):
         return self._pk.vk
 
+    #: Proofs use the keccak transcript so they verify on-chain through
+    #: the generated EVM verifier, like the reference's EvmTranscript
+    #: proofs (verifier/mod.rs:70-83).
+    TRANSCRIPT = "keccak"
+
     def prove(self, pub_ins: list[int], witness: dict) -> bytes:
         # Reuse a pre-synthesized constraint system (the manager's
         # check_circuit pass) rather than rebuilding the k=14 circuit.
@@ -141,10 +147,27 @@ class PlonkEpochProver(Prover):
             cs = self._prove_statement(
                 witness["attestations"], pub_ins, **self._params
             )
-        return self._plonk.prove(self._pk, cs, pub_ins)
+        return self._plonk.prove(self._pk, cs, pub_ins, transcript=self.TRANSCRIPT)
 
     def verify(self, pub_ins: list[int], proof: bytes) -> bool:
-        return self._plonk.verify(self._pk.vk, pub_ins, proof)
+        return self._plonk.verify(
+            self._pk.vk, pub_ins, proof, transcript=self.TRANSCRIPT
+        )
+
+    def generate_verifier_artifact(self):
+        """Generate the EVM verifier contract for this circuit (the
+        gen_evm_verifier_code analog): proves the keygen dummy
+        statement once to pin the quotient-chunk count, then emits
+        bytecode.  Returns a GeneratedVerifier."""
+        from .evm_verifier import generate_evm_verifier, infer_n_t
+
+        atts, pub = self._dummy_statement
+        cs = self._prove_statement(atts, pub, **self._params)
+        sample = self._plonk.prove(self._pk, cs, pub, transcript=self.TRANSCRIPT)
+        n_t = infer_n_t(self._pk.vk, sample)
+        return generate_evm_verifier(
+            self._pk.vk, n_t, self._params["num_neighbours"]
+        )
 
 
 class PoseidonCommitmentProver(Prover):

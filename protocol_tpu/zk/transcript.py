@@ -66,6 +66,91 @@ class PoseidonWrite(PoseidonTranscript):
         return bytes(self._buf)
 
 
+class KeccakTranscript:
+    """Keccak Fiat-Shamir transcript — the EVM-flow analog of the
+    reference's snark-verifier ``EvmTranscript`` (used by gen_proof for
+    on-chain verification, verifier/mod.rs:70-83): scalars and point
+    coordinates absorb as 32-byte big-endian words (EVM word order),
+    and challenges are keccak256(state ‖ pending) reduced mod Fr, so a
+    generated verifier contract replays the transcript with the native
+    KECCAK256 opcode instead of ~60 Poseidon rounds per absorb."""
+
+    def __init__(self):
+        self.state = b"\0" * 32
+        self.pending = bytearray()
+
+    def common_scalar(self, scalar: int) -> None:
+        self.pending += (scalar % field.MODULUS).to_bytes(32, "big")
+
+    def common_point(self, point: G1) -> None:
+        if not is_on_curve(point):
+            raise ValueError("point not on curve")
+        self.pending += point.x.to_bytes(32, "big")
+        self.pending += point.y.to_bytes(32, "big")
+
+    def squeeze_challenge(self) -> int:
+        from ..crypto.keccak import keccak256
+
+        digest = keccak256(self.state + bytes(self.pending))
+        self.state = digest
+        self.pending.clear()
+        return int.from_bytes(digest, "big") % field.MODULUS
+
+
+class KeccakWrite(KeccakTranscript):
+    """Prover side: absorb + serialize (big-endian wire format)."""
+
+    def __init__(self):
+        super().__init__()
+        self._buf = bytearray()
+
+    def write_scalar(self, scalar: int) -> None:
+        self.common_scalar(scalar)
+        self._buf += (scalar % field.MODULUS).to_bytes(32, "big")
+
+    def write_point(self, point: G1) -> None:
+        self.common_point(point)
+        self._buf += point.x.to_bytes(32, "big")
+        self._buf += point.y.to_bytes(32, "big")
+
+    def finalize(self) -> bytes:
+        return bytes(self._buf)
+
+
+class KeccakRead(KeccakTranscript):
+    """Verifier side: replay a big-endian proof blob."""
+
+    def __init__(self, proof: bytes):
+        super().__init__()
+        self._buf = proof
+        self._off = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._off + n > len(self._buf):
+            raise ValueError("transcript exhausted")
+        out = self._buf[self._off : self._off + n]
+        self._off += n
+        return out
+
+    def read_scalar(self) -> int:
+        raw = int.from_bytes(self._take(32), "big")
+        if raw >= field.MODULUS:
+            raise ValueError("non-canonical scalar encoding")
+        self.common_scalar(raw)
+        return raw
+
+    def read_point(self) -> G1:
+        from .rns import FQ_MODULUS
+
+        x = int.from_bytes(self._take(32), "big")
+        y = int.from_bytes(self._take(32), "big")
+        if x >= FQ_MODULUS or y >= FQ_MODULUS:
+            raise ValueError("non-canonical point encoding")
+        point = G1(x, y)
+        self.common_point(point)
+        return point
+
+
 class PoseidonRead(PoseidonTranscript):
     """Verifier side: replay a proof blob, re-deriving the identical
     challenge stream."""

@@ -1,0 +1,73 @@
+"""Offline params/verifier generator — the reference's ``circuit``
+binary analog (circuit/src/main.rs:16-106): generates the KZG SRS file
+(params-{k}.bin analog), compiles the epoch circuit's proving key from
+it, emits the EVM verifier contract artifact (et_verifier.bin analog)
+and a sample proof (et_proof.json analog), all into data/.
+
+Usage:  python tools/gen_et_verifier.py [--data-dir data] [--k 15]
+
+A node booted with ``"prover": "plonk", "srs_path": "data/srs-15.bin"``
+then serves proofs that verify against the emitted artifact — clients
+run them through the in-process EVM (EtVerifierWrapper flow).
+"""
+
+from __future__ import annotations
+
+import argparse
+import secrets
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default="data")
+    ap.add_argument("--k", type=int, default=15, help="SRS size (2^k points)")
+    args = ap.parse_args()
+    data = Path(args.data_dir)
+    data.mkdir(exist_ok=True)
+
+    from protocol_tpu.zk.kzg import Setup
+    from protocol_tpu.zk.proof import PlonkEpochProver, Proof
+
+    srs_path = data / f"srs-{args.k}.bin"
+    if srs_path.exists():
+        print(f"loading existing SRS {srs_path}")
+        srs = Setup.from_bytes(srs_path.read_bytes())
+    else:
+        t0 = time.time()
+        srs = Setup.generate(args.k, seed=secrets.token_bytes(32))
+        srs_path.write_bytes(srs.to_bytes())
+        print(f"SRS 2^{args.k} generated in {time.time() - t0:.1f}s -> {srs_path}")
+
+    t0 = time.time()
+    prover = PlonkEpochProver(srs=srs)
+    print(f"keygen in {time.time() - t0:.1f}s")
+
+    t0 = time.time()
+    gen = prover.generate_verifier_artifact()
+    out = data / "et_verifier.bin"
+    out.write_bytes(gen.to_bytes())
+    print(
+        f"verifier artifact in {time.time() - t0:.1f}s -> {out} "
+        f"({len(gen.runtime)} bytes runtime, n_t={gen.n_t})"
+    )
+
+    # Sample proof over the dummy statement (et_proof.json analog).
+    atts, pub = prover._dummy_statement
+    proof = prover.prove(pub, {"attestations": atts})
+    (data / "et_proof.json").write_text(
+        Proof(pub_ins=pub, proof=proof).to_raw().to_json()
+    )
+    from protocol_tpu.zk.evm_verifier import evm_verify
+
+    ok, gas = evm_verify(gen, pub, proof)
+    print(f"sample proof verifies on EVM: {ok} (gas {gas})")
+    assert ok
+
+
+if __name__ == "__main__":
+    main()
