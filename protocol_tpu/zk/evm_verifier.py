@@ -754,8 +754,6 @@ def generate_wrapper(verifier_addr: int) -> bytes:
     forwards its entire calldata (pub_ins ‖ proof) to the raw verifier
     via STATICCALL, reverting "verifier-missing" when no code is
     deployed there and "verification-failed" when the proof is bad."""
-    from ..evm.machine import asm
-
     return asm(
         verifier_addr,
         "EXTCODESIZE",

@@ -1350,12 +1350,33 @@ def verify(
 ) -> bool:
     _TRANSCRIPTS[transcript]  # unknown backend name must raise, not "invalid proof"
     try:
-        return _verify_inner(vk, instances, proof, transcript)
+        return _verify_inner(vk, instances, proof, transcript) is True
     except (ValueError, AssertionError, IndexError, KeyError):
         return False
 
 
-def _verify_inner(vk, instances, proof, transcript: str = "poseidon") -> bool:
+def verify_deferred(
+    vk: VerifyingKey,
+    instances: dict[str, list[int]] | list[int],
+    proof: bytes,
+    transcript: str = "poseidon",
+):
+    """Run every verifier check EXCEPT the final pairing; returns the
+    accumulator pair (B, A) satisfying e(B, g2) == e(A, tau_g2) iff the
+    proof is valid, or None when any non-pairing check fails.  The
+    batch-verification primitive behind zk.aggregator (the reference's
+    snark-verifier NativeLoader accumulation, verifier/aggregator.rs)."""
+    _TRANSCRIPTS[transcript]
+    try:
+        out = _verify_inner(vk, instances, proof, transcript, defer_pairing=True)
+    except (ValueError, AssertionError, IndexError, KeyError):
+        return None
+    return out if isinstance(out, tuple) else None
+
+
+def _verify_inner(
+    vk, instances, proof, transcript: str = "poseidon", defer_pairing: bool = False
+):
     k, n = vk.k, vk.n
     domain = Domain(k)
     w = domain.omega
@@ -1540,4 +1561,6 @@ def _verify_inner(vk, instances, proof, transcript: str = "poseidon") -> bool:
         A = A.add(W.mul(u_pow) if u_pow != 1 else W)
         u_pow = u_pow * u % R
     srs = vk.srs
+    if defer_pairing:
+        return (B, A)
     return pairing_check([(B, srs.g2), (A.neg(), srs.tau_g2)])

@@ -158,16 +158,19 @@ class PlonkEpochProver(Prover):
         """Generate the EVM verifier contract for this circuit (the
         gen_evm_verifier_code analog): proves the keygen dummy
         statement once to pin the quotient-chunk count, then emits
-        bytecode.  Returns a GeneratedVerifier."""
+        bytecode.  Returns (GeneratedVerifier, sample_pub_ins,
+        sample_proof) — the sample is expensive (a full prove), so
+        callers reuse it rather than proving again."""
         from .evm_verifier import generate_evm_verifier, infer_n_t
 
         atts, pub = self._dummy_statement
         cs = self._prove_statement(atts, pub, **self._params)
         sample = self._plonk.prove(self._pk, cs, pub, transcript=self.TRANSCRIPT)
         n_t = infer_n_t(self._pk.vk, sample)
-        return generate_evm_verifier(
+        gen = generate_evm_verifier(
             self._pk.vk, n_t, self._params["num_neighbours"]
         )
+        return gen, pub, sample
 
 
 class PoseidonCommitmentProver(Prover):

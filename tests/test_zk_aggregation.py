@@ -132,3 +132,78 @@ class TestPoseidonTranscript:
         r = PoseidonRead(proof[:16])
         with pytest.raises(ValueError, match="exhausted"):
             r.read_scalar()
+
+
+class TestAggregation:
+    """KZG accumulation (zk.aggregator) — the working analog of the
+    reference's unfinished aggregator (verifier/aggregator.rs): k
+    proofs batch-verify with one pairing check."""
+
+    @classmethod
+    def setup_class(cls):
+        from protocol_tpu.zk import plonk
+        from protocol_tpu.zk.cs import ConstraintSystem
+        from protocol_tpu.zk.gadgets import StdGate
+        from protocol_tpu.zk.kzg import Setup
+
+        srs = Setup.generate(8, seed=b"agg-test")
+
+        def circuit(a, b, c, pub):
+            cs = ConstraintSystem()
+            std = StdGate(cs)
+            out = std.add(std.mul(std.witness(a), std.witness(b)), std.witness(c))
+            inst = cs.column("instance", "instance")
+            cs.copy(cs.assign(inst, 0, pub), out)
+            return cs
+
+        cls.pk = plonk.compile_circuit(circuit(3, 4, 5, 17), srs=srs)
+        cls.snarks = []
+        from protocol_tpu.zk.aggregator import Snark
+
+        for seed, (a, b, c) in [(b"1", (3, 4, 5)), (b"2", (2, 8, 3)), (b"3", (6, 6, 6))]:
+            pub = a * b + c
+            proof = plonk.prove(cls.pk, circuit(a, b, c, pub), [pub], seed=seed)
+            cls.snarks.append(Snark(cls.pk.vk, [pub], proof))
+
+    def test_batch_of_three_verifies(self):
+        from protocol_tpu.zk.aggregator import aggregate_verify
+
+        assert aggregate_verify(self.snarks)
+
+    def test_single_also_verifies(self):
+        from protocol_tpu.zk.aggregator import aggregate_verify
+
+        assert aggregate_verify(self.snarks[:1])
+
+    def test_wrong_instance_member_rejects_batch(self):
+        from protocol_tpu.zk.aggregator import Snark, aggregate_verify
+
+        bad = Snark(self.pk.vk, [20], self.snarks[1].proof)
+        assert not aggregate_verify([self.snarks[0], bad, self.snarks[2]])
+
+    def test_tampered_member_rejects_batch(self):
+        from protocol_tpu.zk.aggregator import Snark, aggregate_verify
+
+        t = bytearray(self.snarks[2].proof)
+        t[40] ^= 1
+        bad = Snark(self.pk.vk, self.snarks[2].instances, bytes(t))
+        assert not aggregate_verify([self.snarks[0], self.snarks[1], bad])
+
+    def test_accumulator_roundtrip(self):
+        from protocol_tpu.zk.aggregator import Accumulator, accumulate, finalize
+
+        acc = accumulate(self.snarks)
+        assert acc is not None
+        restored = Accumulator.from_bytes(acc.to_bytes())
+        assert finalize(restored, self.pk.vk)
+
+    def test_deferred_pairing_matches_direct(self):
+        from protocol_tpu.zk import plonk
+        from protocol_tpu.zk.fields import pairing_check
+
+        s = self.snarks[0]
+        pair = plonk.verify_deferred(s.vk, s.instances, s.proof)
+        assert pair is not None
+        b, a = pair
+        assert pairing_check([(b, s.vk.srs.g2), (a.neg(), s.vk.srs.tau_g2)])
+        assert plonk.verify(s.vk, s.instances, s.proof)

@@ -48,7 +48,7 @@ def main() -> None:
     print(f"keygen in {time.time() - t0:.1f}s")
 
     t0 = time.time()
-    gen = prover.generate_verifier_artifact()
+    gen, pub, proof = prover.generate_verifier_artifact()
     out = data / "et_verifier.bin"
     out.write_bytes(gen.to_bytes())
     print(
@@ -56,9 +56,8 @@ def main() -> None:
         f"({len(gen.runtime)} bytes runtime, n_t={gen.n_t})"
     )
 
-    # Sample proof over the dummy statement (et_proof.json analog).
-    atts, pub = prover._dummy_statement
-    proof = prover.prove(pub, {"attestations": atts})
+    # Sample proof over the dummy statement (et_proof.json analog) —
+    # the one generate_verifier_artifact already produced.
     (data / "et_proof.json").write_text(
         Proof(pub_ins=pub, proof=proof).to_raw().to_json()
     )
