@@ -192,9 +192,15 @@ class EigenTrustClient:
         if self.use_chain():
             return self._verify_web3(proof_raw)
         proof = proof_raw.to_proof()
-        # Commitment-backend proofs are 32-byte digest + JSON payload;
-        # dispatch on shape, not on what files happen to exist in CWD.
-        if proof.proof[32:33] == b"{":
+        # Dispatch on the explicit backend tag when the node sent one;
+        # for untagged (reference-format) payloads fall back to shape:
+        # commitment proofs are 32-byte digest + JSON payload.
+        is_commitment = (
+            proof_raw.backend == "commitment"
+            if proof_raw.backend
+            else proof.proof[32:33] == b"{"
+        )
+        if is_commitment:
             from ..zk.proof import PoseidonCommitmentProver
 
             return PoseidonCommitmentProver().verify(proof.pub_ins, proof.proof)

@@ -127,16 +127,37 @@ class Precompiles:
     """0x05 modexp, 0x06 ecAdd, 0x07 ecMul, 0x08 ecPairing (Istanbul
     gas), implemented over the framework's own Bn254 stack."""
 
+    #: Istanbul static costs, shared by the pre-check and the
+    #: implementations so a repricing can't drift between them.
+    EC_ADD_GAS = 150
+    EC_MUL_GAS = 6000
+    PAIRING_BASE_GAS = 45000
+    PAIRING_PER_PAIR_GAS = 34000
+
     @staticmethod
-    def run(addr: int, data: bytes) -> tuple[bool, bytes, int]:
-        """-> (success, returndata, gas_cost)"""
+    def pairing_gas(data: bytes) -> int:
+        return Precompiles.PAIRING_BASE_GAS + Precompiles.PAIRING_PER_PAIR_GAS * (
+            len(data) // 192
+        )
+
+    @staticmethod
+    def run(addr: int, data: bytes, gas_limit: int | None = None) -> tuple[bool, bytes, int]:
+        """-> (success, returndata, gas_cost).  When ``gas_limit`` is
+        given, the cost is computed and checked *before* any expensive
+        work, so hostile inputs can't burn CPU they haven't paid for."""
         if addr == 0x05:
-            return Precompiles._modexp(data)
+            return Precompiles._modexp(data, gas_limit)
         if addr == 0x06:
+            if gas_limit is not None and gas_limit < Precompiles.EC_ADD_GAS:
+                return False, b"", Precompiles.EC_ADD_GAS
             return Precompiles._ec_add(data)
         if addr == 0x07:
+            if gas_limit is not None and gas_limit < Precompiles.EC_MUL_GAS:
+                return False, b"", Precompiles.EC_MUL_GAS
             return Precompiles._ec_mul(data)
         if addr == 0x08:
+            if gas_limit is not None and gas_limit < Precompiles.pairing_gas(data):
+                return False, b"", Precompiles.pairing_gas(data)
             return Precompiles._pairing(data)
         raise EvmError(f"unsupported precompile {addr:#x}")
 
@@ -146,26 +167,31 @@ class Precompiles:
         return int.from_bytes(chunk.ljust(32, b"\0"), "big")
 
     @staticmethod
-    def _modexp(data: bytes):
+    def _modexp(data: bytes, gas_limit: int | None = None):
         blen = Precompiles._word(data, 0)
         elen = Precompiles._word(data, 1)
         mlen = Precompiles._word(data, 2)
         if max(blen, elen, mlen) > 1024:
+            # Failing precompile: the call handler consumes the forwarded gas.
             return False, b"", 0
         body = data[96:].ljust(blen + elen + mlen, b"\0")
+        # EIP-2565 gas, computed from the lengths + exponent head before
+        # the pow runs so unpaid work never executes.
+        e_head = int.from_bytes(body[blen : blen + 32].ljust(32, b"\0")[: min(elen, 32)], "big")
+        words = (max(blen, mlen) + 7) // 8
+        mult = words * words
+        adj = (
+            max(e_head.bit_length() - 1, 0)
+            if elen <= 32
+            else 8 * (elen - 32) + max(e_head.bit_length() - 1, 0)
+        )
+        gas = max(200, mult * max(adj, 1) // 3)
+        if gas_limit is not None and gas > gas_limit:
+            return False, b"", gas
         b = int.from_bytes(body[:blen], "big")
         e = int.from_bytes(body[blen : blen + elen], "big")
         m = int.from_bytes(body[blen + elen : blen + elen + mlen], "big")
         out = pow(b, e, m) if m else 0
-        # EIP-2565 gas.
-        words = (max(blen, mlen) + 7) // 8
-        mult = words * words
-        adj = max(e.bit_length() - 1, 0) if elen <= 32 else 8 * (elen - 32) + max(
-            Precompiles._word(body[blen : blen + 32].rjust(32, b"\0"), 0).bit_length()
-            - 1,
-            0,
-        )
-        gas = max(200, mult * max(adj, 1) // 3)
         return True, out.to_bytes(mlen, "big") if mlen else b"", gas
 
     @staticmethod
@@ -187,19 +213,19 @@ class Precompiles:
             a = Precompiles._g1(data, 0)
             b = Precompiles._g1(data, 2)
         except EvmError:
-            return False, b"", 150
+            return False, b"", Precompiles.EC_ADD_GAS
         c = a.add(b)
-        return True, c.x.to_bytes(32, "big") + c.y.to_bytes(32, "big"), 150
+        return True, c.x.to_bytes(32, "big") + c.y.to_bytes(32, "big"), Precompiles.EC_ADD_GAS
 
     @staticmethod
     def _ec_mul(data: bytes):
         try:
             a = Precompiles._g1(data, 0)
         except EvmError:
-            return False, b"", 6000
+            return False, b"", Precompiles.EC_MUL_GAS
         s = Precompiles._word(data, 2)
         c = a.mul(s % _FR) if s else a.mul(0)
-        return True, c.x.to_bytes(32, "big") + c.y.to_bytes(32, "big"), 6000
+        return True, c.x.to_bytes(32, "big") + c.y.to_bytes(32, "big"), Precompiles.EC_MUL_GAS
 
     @staticmethod
     def _pairing(data: bytes):
@@ -207,9 +233,9 @@ class Precompiles:
         from ..zk.fields import FQ2, G2, g2_in_subgroup, g2_is_on_curve, pairing_check
 
         if len(data) % 192 != 0:
-            return False, b"", 45000
+            return False, b"", Precompiles.PAIRING_BASE_GAS
         n = len(data) // 192
-        gas = 45000 + 34000 * n
+        gas = Precompiles.pairing_gas(data)
         pairs = []
         for i in range(n):
             base = 6 * i
@@ -295,7 +321,13 @@ class EVM:
     # -- core loop ------------------------------------------------------
 
     def _execute(
-        self, code: bytes, calldata: bytes, gas: int, depth: int, self_addr: int
+        self,
+        code: bytes,
+        calldata: bytes,
+        gas: int,
+        depth: int,
+        self_addr: int,
+        static: bool = False,
     ) -> Receipt:
         if depth > 8:
             return Receipt(False, b"", 0, "call depth exceeded")
@@ -486,6 +518,8 @@ class EVM:
                 elif opcode == 0x54:  # SLOAD
                     push(store.get(pop(), 0))
                 elif opcode == 0x55:  # SSTORE
+                    if static:
+                        raise EvmError("state modification in static context")
                     k, v = pop(), pop()
                     store[k] = v
                 elif opcode == 0x56:  # JUMP
@@ -538,13 +572,20 @@ class EVM:
                         pop(),
                     )
                     data = mread(in_off, in_size)
+                    sub_gas = min(call_gas, max(gas_left - gas_left // 64, 0))
                     if 1 <= to <= 0x09:
-                        ok, out, pgas = Precompiles.run(to, data)
-                        use(pgas)
+                        ok, out, pgas = Precompiles.run(to, data, sub_gas)
+                        # Real EVM: a failing precompile (or one whose cost
+                        # exceeds the forwarded gas) consumes the forwarded
+                        # gas and the call fails; success pays metered cost.
+                        if ok and pgas <= sub_gas:
+                            use(pgas)
+                        else:
+                            ok, out = False, b""
+                            use(sub_gas)
                     elif to in self.code:
-                        sub_gas = min(call_gas, max(gas_left - gas_left // 64, 0))
                         r = self._execute(
-                            self.code[to], data, sub_gas, depth + 1, to
+                            self.code[to], data, sub_gas, depth + 1, to, static=True
                         )
                         use(r.gas_used)
                         ok, out = r.success, r.returndata

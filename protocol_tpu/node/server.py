@@ -37,6 +37,14 @@ INTERNAL_SERVER_ERROR = 500
 _STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found", 500: "Internal Server Error"}
 
 
+def _backend_tag(manager: Manager) -> str:
+    """Wire tag for the proof backend, declared by the Prover class
+    itself — so clients dispatch on an explicit field instead of
+    sniffing proof bytes.  Unknown provers serve an empty tag and
+    clients fall back to shape detection."""
+    return getattr(manager.prover, "wire_tag", "")
+
+
 def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
     """Route one request (main.rs:85-119 + the rebuild's observability
     surface).  Returns (status, body)."""
@@ -46,7 +54,7 @@ def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
         except EigenError as e:
             log.info("score query failed: %s", e)
             return BAD_REQUEST, "InvalidQuery"
-        return 200, proof.to_raw().to_json()
+        return 200, proof.to_raw(backend=_backend_tag(manager)).to_json()
     if method == "GET" and path == "/status":
         status = {
             "attestations": len(manager.attestations),
@@ -139,7 +147,11 @@ class Node:
             # (ingest keeps mutating the attestation cache concurrently;
             # a rebuilt graph could have more peers than scores).
             graph = self.manager.last_graph if scores is not None else self.manager.build_graph()
-            proof_json = self.manager.get_proof(epoch).to_raw().to_json()
+            proof_json = (
+                self.manager.get_proof(epoch)
+                .to_raw(backend=_backend_tag(self.manager))
+                .to_json()
+            )
             with TELEMETRY.timer("epoch.checkpoint"):
                 CheckpointStore(self.config.checkpoint_dir).save(
                     epoch, graph, scores, proof_json

@@ -654,7 +654,15 @@ def compile_circuit(
         # via ``srs`` / loaded with Setup.from_bytes for anything whose
         # verifiers don't trust the prover's machine.
         srs = Setup.generate(k + 1, seed=secrets.token_bytes(32))
-    assert srs.n >= n + 4, "SRS too small for blinded polynomials"
+    # Headroom for blinded polynomials: advice columns get
+    # len(rotations)+1 blinders (see prove), permutation z gets 4.
+    max_blind = max(
+        [4] + [len(rots) + 1 for slot, rots in gate_rots.items() if slot < len(advice)]
+    )
+    assert srs.n >= n + max_blind, (
+        f"SRS too small for blinded polynomials: need {n + max_blind} powers "
+        f"(degree bound n={n} + {max_blind} blinders), have {srs.n}"
+    )
 
     fixed_commits = [srs.commit(p) for p in fixed_polys]
     sigma_commits = [srs.commit(p) for p in sigma_polys]
@@ -1029,8 +1037,13 @@ def prove(
     for i, vals in enumerate(pk.fixed_values):
         slot_values[n_adv + n_inst + i] = vals
 
-    # Round 1: advice commitments (opened at ≤2 rotations; 3 blinders).
-    advice_polys = [blind(domain.ifft(v), 3) for v in advice_values]
+    # Round 1: advice commitments.  Zero-knowledge needs one blinder more
+    # than the number of opening points, so derive the count from the
+    # rotations each column is actually opened at instead of assuming 2.
+    advice_polys = [
+        blind(domain.ifft(v), len(vk.gate_rots.get(i, ())) + 1)
+        for i, v in enumerate(advice_values)
+    ]
     for p in advice_polys:
         transcript.write_point(srs.commit(p))
 

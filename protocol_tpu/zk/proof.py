@@ -19,9 +19,11 @@ class Proof:
     pub_ins: list[int]
     proof: bytes
 
-    def to_raw(self) -> "ProofRaw":
+    def to_raw(self, backend: str = "") -> "ProofRaw":
         return ProofRaw(
-            pub_ins=[field.to_le_bytes(x) for x in self.pub_ins], proof=self.proof
+            pub_ins=[field.to_le_bytes(x) for x in self.pub_ins],
+            proof=self.proof,
+            backend=backend,
         )
 
 
@@ -29,6 +31,11 @@ class Proof:
 class ProofRaw:
     pub_ins: list[bytes]
     proof: bytes
+    #: Which prover produced ``proof``: "plonk" / "commitment" / ""
+    #: (unknown — proof from a peer that predates the tag).  Serialized
+    #: as an extra JSON key; absent on reference-format payloads, so
+    #: round-tripping stays wire-compatible both ways.
+    backend: str = ""
 
     def to_proof(self) -> Proof:
         return Proof(
@@ -37,12 +44,13 @@ class ProofRaw:
 
     def to_json(self) -> str:
         # serde serializes [u8; 32] and Vec<u8> as JSON integer arrays.
-        return json.dumps(
-            {
-                "pub_ins": [list(x) for x in self.pub_ins],
-                "proof": list(self.proof),
-            }
-        )
+        obj = {
+            "pub_ins": [list(x) for x in self.pub_ins],
+            "proof": list(self.proof),
+        }
+        if self.backend:
+            obj["backend"] = self.backend
+        return json.dumps(obj)
 
     @classmethod
     def from_json(cls, s: str) -> "ProofRaw":
@@ -50,6 +58,7 @@ class ProofRaw:
         return cls(
             pub_ins=[bytes(x) for x in obj["pub_ins"]],
             proof=bytes(obj["proof"]),
+            backend=obj.get("backend", ""),
         )
 
 
@@ -57,6 +66,10 @@ class Prover:
     """Produces proof bytes binding public inputs to a witness."""
 
     name = "abstract"
+    #: Wire tag served in ProofRaw.backend so clients dispatch without
+    #: sniffing proof bytes.  Empty = unknown; clients fall back to
+    #: shape detection.
+    wire_tag = ""
 
     def prove(self, pub_ins: list[int], witness: dict) -> bytes:
         raise NotImplementedError
@@ -80,6 +93,7 @@ class PlonkEpochProver(Prover):
     """
 
     name = "plonk-kzg"
+    wire_tag = "plonk"
 
     def __init__(
         self,
@@ -128,6 +142,19 @@ class PlonkEpochProver(Prover):
             from .kzg import Setup
 
             srs = Setup.from_bytes(Path(srs_path).read_bytes())
+        if srs is None:
+            # A fresh random setup is fine for development, but its
+            # proofs will not verify against anyone else's
+            # et_verifier.bin (different vk commitments), and its
+            # toxic waste lives on this machine.  Make that loud.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "PLONK prover booted WITHOUT a ceremony SRS (srs_path unset): "
+                "generating a dev-only random setup. Proofs will only verify "
+                "against artifacts generated from this same setup; do not use "
+                "in production."
+            )
         self._pk = plonk.compile_circuit(cs, srs=srs, k=k)
 
     @property
@@ -185,6 +212,7 @@ class PoseidonCommitmentProver(Prover):
     """
 
     name = "poseidon-commitment"
+    wire_tag = "commitment"
     DOMAIN = int.from_bytes(b"protocol_tpu.commit.v1".ljust(32, b"\0"), "little") % field.MODULUS
 
     def _digest(self, pub_ins: list[int], witness: dict) -> int:

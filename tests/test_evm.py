@@ -208,6 +208,58 @@ class TestStaticcallFlow:
         assert r.success, r.error
         assert int.from_bytes(r.returndata, "big") == 42
 
+    def test_staticcall_enforces_read_only(self):
+        """SSTORE inside a STATICCALL frame must fail the inner call
+        (real EVM static-context semantics); the outer frame observes
+        success=0 and the store never lands."""
+        evm = EVM()
+        inner = evm.deploy_runtime(asm(7, 1, "SSTORE", *ret_word(1)))
+        outer = evm.deploy_runtime(
+            asm(
+                *ret_word(32, 0, 0, 0, inner, "GAS", "STATICCALL"),
+            )
+        )
+        r = evm.call(outer, b"")
+        assert r.success, r.error
+        assert int.from_bytes(r.returndata, "big") == 0  # inner call failed
+        assert evm.storage.get(inner, {}).get(1) is None
+
+    def test_sstore_allowed_outside_static(self):
+        evm = EVM()
+        addr = evm.deploy_runtime(asm(7, 1, "SSTORE", *ret_word(1)))
+        r = evm.call(addr, b"")
+        assert r.success, r.error
+        assert evm.storage[addr][1] == 7
+
+    def test_modexp_oversize_consumes_forwarded_gas(self):
+        """A failing precompile consumes the gas forwarded to it — the
+        STATICCALL returns 0 and gas_used reflects the forwarded gas,
+        not the precompile's (zero) metered cost."""
+        big = 2000  # > 1024-byte length cap -> precompile failure
+        calldata = big.to_bytes(32, "big") * 3
+        evm = EVM()
+        outer = evm.deploy_runtime(
+            asm(
+                "CALLDATASIZE", 0, 0, "CALLDATACOPY",
+                *ret_word(32, 0, "CALLDATASIZE", 0, 0x05, 50_000, "STATICCALL"),
+            )
+        )
+        r = evm.call(outer, calldata, gas=1_000_000)
+        assert r.success, r.error
+        assert int.from_bytes(r.returndata, "big") == 0  # precompile failed
+        # Forwarded 50k consumed (plus base costs), far above the
+        # metered-cost-only floor.
+        assert r.gas_used > 50_000
+
+    def test_modexp_unpaid_pow_never_runs(self):
+        """Cost check happens before the pow: a huge exponentiation with
+        a tiny gas limit returns failure with the true cost reported."""
+        blen = elen = mlen = 1024
+        head = b"".join(x.to_bytes(32, "big") for x in (blen, elen, mlen))
+        body = b"\xff" * (blen + elen + mlen)
+        ok, out, gas = Precompiles.run(0x05, head + body, gas_limit=100)
+        assert not ok and out == b"" and gas > 100
+
     def test_staticcall_precompile_from_bytecode(self):
         """ecMul via STATICCALL from inside a contract."""
         code = asm(
