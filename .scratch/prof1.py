@@ -1,0 +1,31 @@
+import time, jax, jax.numpy as jnp, numpy as np
+
+E, N = 50_000_000, 1_000_000
+rng = np.random.default_rng(0)
+dst = np.sort(rng.integers(0, N, E).astype(np.int32))
+w = rng.random(E, dtype=np.float32)
+t = rng.random(N, dtype=np.float32)
+srcr = rng.integers(0, N, E).astype(np.int32)
+
+src_d = jax.device_put(jnp.asarray(srcr))
+dst_d = jax.device_put(jnp.asarray(dst))
+w_d = jax.device_put(jnp.asarray(w))
+t_d = jax.device_put(jnp.asarray(t))
+_ = float(jnp.sum(w_d))  # drain transfers
+
+def timeit(name, f, *a):
+    g = jax.jit(f)
+    float(g(*a))
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        r = float(g(*a))
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name}: {dt*1000:.1f} ms")
+
+timeit("reduce max(w) [read 200MB]", lambda w: w.max(), w_d)
+timeit("max(w*w2) [read 400MB]", lambda w: (w*jnp.flip(w)).max(), w_d)
+timeit("gather max(t[src])", lambda t, s: t[s].max(), t_d, src_d)
+timeit("gather+mul max(w*t[src])", lambda t, s, w: (w * t[s]).max(), t_d, src_d, w_d)
+timeit("segsum max", lambda w, d: jax.ops.segment_sum(w, d, num_segments=N, indices_are_sorted=True).max(), w_d, dst_d)
+timeit("full COO step max", lambda t, s, d, w: jax.ops.segment_sum(w * t[s], d, num_segments=N, indices_are_sorted=True).max(), t_d, src_d, dst_d, w_d)

@@ -1,0 +1,30 @@
+import time, jax, jax.numpy as jnp, numpy as np
+
+E, N = 50_000_000, 1_000_000
+rng = np.random.default_rng(0)
+w = rng.random(E, dtype=np.float32)
+t = rng.random(N, dtype=np.float32)
+src_sorted = np.sort(rng.integers(0, N, E).astype(np.int32))
+perm = rng.permutation(E).astype(np.int32)
+
+w_d = jax.device_put(jnp.asarray(w))
+t_d = jax.device_put(jnp.asarray(t))
+ss_d = jax.device_put(jnp.asarray(src_sorted))
+perm_d = jax.device_put(jnp.asarray(perm))
+_ = float(jnp.sum(w_d))
+
+def timeit(name, f, *a):
+    g = jax.jit(f)
+    float(g(*a))
+    t0 = time.perf_counter(); reps=3
+    for _ in range(reps): float(g(*a))
+    print(f"{name}: {(time.perf_counter()-t0)/reps*1000:.1f} ms")
+
+import jax.lax as lax
+timeit("sorted gather t[src_sorted]", lambda t,s: jnp.take(t, s, indices_are_sorted=True).max(), t_d, ss_d)
+timeit("fixed perm w[perm]", lambda w,p: w[p].max(), w_d, perm_d)
+timeit("cumsum 50M f32", lambda w: jnp.cumsum(w).max(), w_d)
+timeit("assoc_scan add 50M", lambda w: lax.associative_scan(lambda a,b: a+b, w).max(), w_d)
+from protocol_tpu.ops.sparse import rowsum_sorted
+row_ptr = jax.device_put(jnp.asarray(np.searchsorted(src_sorted, np.arange(N+1)).astype(np.int32)))
+timeit("rowsum_sorted (CSR cumsum)", lambda w,rp: rowsum_sorted(w, rp).max(), w_d, row_ptr)

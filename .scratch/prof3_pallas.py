@@ -1,0 +1,88 @@
+"""Can Mosaic vectorize a gather from a VMEM-resident table?
+
+Table t: 1M f32 (4 MB) resident in VMEM as (8192, 128).
+Edge stream: idx blocks; out[e] = t[idx[e]].
+Try several lowering strategies and time whichever compiles.
+"""
+import sys, time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+E = 2**24  # 16M edges for the micro-bench
+N = 1 << 20
+BLK = 2**17  # edges per grid step (0.5 MB idx)
+
+rng = np.random.default_rng(0)
+idx = rng.integers(0, N, E).astype(np.int32)
+t = rng.random(N, dtype=np.float32)
+
+t2d = jax.device_put(jnp.asarray(t.reshape(N // 128, 128)))
+idx2d = jax.device_put(jnp.asarray(idx.reshape(E // 128, 128)))
+_ = float(jnp.sum(t2d))
+
+grid = (E // BLK,)
+R = BLK // 128  # rows per block
+
+
+def make(kernel_body):
+    return pl.pallas_call(
+        kernel_body,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((N // 128, 128), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((E // 128, 128), jnp.float32),
+    )
+
+
+def v1_direct(t_ref, i_ref, o_ref):
+    ix = i_ref[:]
+    rows = ix // 128
+    cols = ix % 128
+    o_ref[:] = t_ref[rows, cols]
+
+
+def v2_take(t_ref, i_ref, o_ref):
+    flat = t_ref[:].reshape(-1)
+    o_ref[:] = jnp.take(flat, i_ref[:], axis=0)
+
+
+def v3_take_along(t_ref, i_ref, o_ref):
+    # gather rows via take on axis 0, then select lane via take_along_axis
+    ix = i_ref[:]
+    rows = ix // 128
+    cols = ix % 128
+    picked = jnp.take(t_ref[:], rows, axis=0)  # (R,128,128)?? no — rows is 2d
+    o_ref[:] = jnp.take_along_axis(picked, cols[..., None], axis=-1)[..., 0]
+
+
+def bench(name, fn):
+    try:
+        g = jax.jit(lambda t, i: fn(t, i).max())
+        r = float(g(t2d, idx2d))
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            r = float(g(t2d, idx2d))
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name}: {dt*1000:.2f} ms  ({E/dt/1e9:.2f} Gelem/s)", flush=True)
+    except Exception as e:
+        msg = str(e).split(chr(10))[0][:200]
+        print(f"{name}: FAILED — {type(e).__name__}: {msg}", flush=True)
+
+
+for name, body in [("v1 direct t[rows,cols]", v1_direct),
+                   ("v2 take(flat)", v2_take),
+                   ("v3 take rows + take_along lanes", v3_take_along)]:
+    bench(name, make(body))
+
+# XLA baseline at same size
+g = jax.jit(lambda t, i: jnp.take(t.reshape(-1), i.reshape(-1)).max())
+float(g(t2d, idx2d))
+t0 = time.perf_counter()
+for _ in range(3):
+    float(g(t2d, idx2d))
+print(f"XLA gather baseline: {(time.perf_counter()-t0)/3*1000:.2f} ms", flush=True)

@@ -1,0 +1,99 @@
+"""Map the dynamic_gather support surface: which (table, axis) shapes compile,
+plus scalar dynamic loads, dynamic-row accumulate, sublane roll — the
+primitives available for kernel design. Also XLA converge_csr at bench scale."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+rng = np.random.default_rng(0)
+
+def bench(name, fn, *args, reps=3):
+    try:
+        g = jax.jit(lambda *a: fn(*a).max())
+        float(g(*args))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            float(g(*args))
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name}: {dt*1000:.2f} ms", flush=True)
+    except Exception as e:
+        s = str(e).splitlines()
+        s = s[0][:140] if s else type(e).__name__
+        print(f"{name}: FAILED — {s}", flush=True)
+
+def gather_axis(rows, lanes, axis):
+    t = jax.device_put(jnp.asarray(rng.random(rows * lanes, dtype=np.float32).reshape(rows, lanes)))
+    hi = rows if axis == 0 else lanes
+    ix = jax.device_put(jnp.asarray(rng.integers(0, hi, (rows, lanes)).astype(np.int32)))
+    def k(t_ref, i_ref, o_ref):
+        o_ref[:] = jnp.take_along_axis(t_ref[:], i_ref[:], axis=axis)
+    call = pl.pallas_call(
+        k,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM), pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, lanes), jnp.float32),
+    )
+    bench(f"axis{axis} ({rows},{lanes})", call, t, ix)
+
+for rows, lanes in [(8, 128), (64, 128), (512, 128), (1024, 128), (4096, 128)]:
+    gather_axis(rows, lanes, 0)
+for rows, lanes in [(8, 1024), (128, 8192), (1024, 1024), (8192, 256)]:
+    gather_axis(rows, lanes, 1)
+
+# axis1 throughput at scale: grid over a big stream, table-shaped (8192,128) blocks
+E = 2**25  # 33.5M
+t2 = jax.device_put(jnp.asarray(rng.random(1 << 20, dtype=np.float32).reshape(8192, 128)))
+cb = jax.device_put(jnp.asarray(rng.integers(0, 128, (E // 128, 128)).astype(np.int32)))
+wb = jax.device_put(jnp.asarray(rng.random((E // 128, 128), dtype=np.float32)))
+
+def k_stream(t_ref, c_ref, w_ref, o_ref):
+    o_ref[:] = w_ref[:] * jnp.take_along_axis(t_ref[:], c_ref[:], axis=1)
+
+stream = pl.pallas_call(
+    k_stream,
+    grid=(E // (8192 * 128),),
+    in_specs=[
+        pl.BlockSpec((8192, 128), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((8192, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((8192, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    ],
+    out_specs=pl.BlockSpec((8192, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    out_shape=jax.ShapeDtypeStruct((E // 128, 128), jnp.float32),
+)
+bench("axis1 streamed 33.5M (row-local gather+mul)", stream, t2, cb, wb)
+
+# sublane roll (static) + select — Benes building blocks
+def k_roll(x_ref, m_ref, o_ref):
+    x = x_ref[:]
+    for d in (1, 2, 4):
+        p = jnp.roll(x, d, axis=0)
+        x = jnp.where(m_ref[:] > d, p, x)
+    o_ref[:] = x
+x8 = jax.device_put(jnp.asarray(rng.random((8192, 128), dtype=np.float32)))
+m8 = jax.device_put(jnp.asarray(rng.integers(0, 8, (8192, 128)).astype(np.int32)))
+roll = pl.pallas_call(
+    k_roll,
+    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM), pl.BlockSpec(memory_space=pltpu.VMEM)],
+    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    out_shape=jax.ShapeDtypeStruct((8192, 128), jnp.float32),
+)
+bench("roll+select x3 (8192,128)", roll, x8, m8)
+
+# dynamic-row accumulate: o[r, :] += v for scalar r from SMEM
+def k_acc(r_ref, x_ref, o_ref):
+    o_ref[:] = jnp.zeros_like(o_ref)
+    def body(i, _):
+        r = r_ref[i]
+        o_ref[r, :] += x_ref[i, :]
+        return 0
+    jax.lax.fori_loop(0, 64, body, 0)
+racc = jax.device_put(jnp.asarray(rng.integers(0, 128, 64).astype(np.int32)))
+xacc = jax.device_put(jnp.asarray(rng.random((64, 128), dtype=np.float32)))
+acc = pl.pallas_call(
+    k_acc,
+    in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), pl.BlockSpec(memory_space=pltpu.VMEM)],
+    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+)
+bench("dynamic-row accumulate (64 rows)", acc, racc, xacc)

@@ -1,0 +1,71 @@
+"""Decision measurements: (a) converge_csr at bench scale, (b) XLA
+gather/scatter vs index locality and table size, (c) rowsum_sorted cost."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+
+rng = np.random.default_rng(0)
+
+def bench(name, fn, *args, reps=3):
+    try:
+        g = jax.jit(fn)
+        r = jax.tree.map(np.asarray, g(*args))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = jax.tree.map(np.asarray, g(*args))
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name}: {dt*1000:.1f} ms", flush=True)
+    except Exception as e:
+        s = str(e).splitlines()
+        print(f"{name}: FAILED — {s[0][:140] if s else type(e).__name__}", flush=True)
+
+E, N = 50_000_000, 1_000_000
+
+# (b) locality experiments at 8M edges
+Es = 8_000_000
+t_small = jax.device_put(jnp.asarray(rng.random(N, dtype=np.float32)))
+idx_rand = jax.device_put(jnp.asarray(rng.integers(0, N, Es).astype(np.int32)))
+# localized: indices within 16K-wide windows, window advancing with position
+base = (np.arange(Es) // (Es // 64)) * (N // 64)
+idx_loc = jax.device_put(jnp.asarray((base + rng.integers(0, N // 64, Es)).astype(np.int32)))
+t_tiny = jax.device_put(jnp.asarray(rng.random(16384, dtype=np.float32)))
+idx_tiny = jax.device_put(jnp.asarray(rng.integers(0, 16384, Es).astype(np.int32)))
+_ = float(jnp.sum(t_small))
+
+bench("gather 8M from 1M table, random idx", lambda t, i: t[i].max(), t_small, idx_rand)
+bench("gather 8M from 1M table, 16K-local idx", lambda t, i: t[i].max(), t_small, idx_loc)
+bench("gather 8M from 16K table", lambda t, i: t[i].max(), t_tiny, idx_tiny)
+
+v8 = jax.device_put(jnp.asarray(rng.random(Es, dtype=np.float32)))
+seg_sorted = jax.device_put(jnp.asarray(np.sort(rng.integers(0, N, Es)).astype(np.int32)))
+seg_small = jax.device_put(jnp.asarray(np.sort(rng.integers(0, 16384, Es)).astype(np.int32)))
+bench("segsum 8M -> 1M sorted", lambda v, s: jax.ops.segment_sum(v, s, num_segments=N, indices_are_sorted=True).max(), v8, seg_sorted)
+bench("segsum 8M -> 16K sorted", lambda v, s: jax.ops.segment_sum(v, s, num_segments=16384, indices_are_sorted=True).max(), v8, seg_small)
+
+# scatter 1M values into a 50M array (expand-trick boundary scatter)
+pos = jax.device_put(jnp.asarray(np.sort(rng.choice(E, N, replace=False)).astype(np.int32)))
+vals = jax.device_put(jnp.asarray(rng.random(N, dtype=np.float32)))
+bench("scatter-add 1M into 50M", lambda p, v: jnp.zeros(E, jnp.float32).at[p].add(v).max(), pos, vals)
+
+# (c) rowsum_sorted at full scale
+from protocol_tpu.ops.sparse import rowsum_sorted
+contrib = jax.device_put(jnp.asarray(rng.random(E, dtype=np.float32)))
+row_ptr = jax.device_put(jnp.asarray(np.searchsorted(np.sort(rng.integers(0, N, E)), np.arange(N + 1)).astype(np.int32)))
+bench("rowsum_sorted 50M->1M", lambda c, rp: rowsum_sorted(c, rp).max(), contrib, row_ptr)
+
+# (a) converge_csr at bench scale — the repo's fast path claim
+from protocol_tpu.models.graphs import scale_free
+from protocol_tpu.trust.graph import TrustGraph
+from protocol_tpu.ops.sparse import converge_csr
+
+graph = scale_free(N, E, seed=7)
+g0 = graph.drop_self_edges()
+w, dangling = g0.row_normalized()
+g = TrustGraph(g0.n, g0.src, g0.dst, w, graph.pre_trusted).sorted_by_dst()
+p = graph.pre_trust_vector()
+rp = np.searchsorted(g.dst, np.arange(N + 1)).astype(np.int32)
+args = tuple(jax.device_put(jnp.asarray(x)) for x in
+             (g.src, rp, g.weight, p, p, dangling.astype(np.float32)))
+_ = float(jnp.sum(args[2]))
+bench("converge_csr 40 iters full bench scale",
+      lambda *a: converge_csr(*a, alpha=jnp.float32(0.1), tol=0.0, max_iter=40)[0],
+      *args, reps=1)

@@ -94,36 +94,30 @@ template <typename P> struct Fp {
         sub(out, zero, a);
     }
 
-    // Montgomery CIOS multiplication.
-    static void mul(Fp &out, const Fp &a, const Fp &b) {
+    // Montgomery CIOS multiplication with the "no-carry" optimization
+    // (valid because the modulus' top word < 2^63 - 1, as for both
+    // Bn254 fields): the running total never overflows 5 words, so the
+    // 6-word temp and its carry juggling disappear.
+    static inline __attribute__((always_inline)) void mul(Fp &out, const Fp &a, const Fp &b) {
         const uint64_t *m = P::mod();
         const uint64_t pinv = P::pinv();
-        uint64_t t[6] = {0, 0, 0, 0, 0, 0};
+        uint64_t t[4] = {0, 0, 0, 0};
         for (int i = 0; i < 4; ++i) {
-            u128 carry = 0;
-            for (int j = 0; j < 4; ++j) {
-                u128 cur = (u128)t[j] + (u128)a.l[i] * b.l[j] + carry;
-                t[j] = (uint64_t)cur;
-                carry = cur >> 64;
-            }
-            u128 cur = (u128)t[4] + carry;
-            t[4] = (uint64_t)cur;
-            t[5] = (uint64_t)(cur >> 64);
-
-            uint64_t mm = t[0] * pinv;
-            carry = ((u128)t[0] + (u128)mm * m[0]) >> 64;
+            u128 cur = (u128)t[0] + (u128)a.l[i] * b.l[0];
+            uint64_t c0 = (uint64_t)(cur >> 64);
+            uint64_t mm = (uint64_t)cur * pinv;
+            u128 red = ((u128)(uint64_t)cur + (u128)mm * m[0]) >> 64;
             for (int j = 1; j < 4; ++j) {
-                u128 c2 = (u128)t[j] + (u128)mm * m[j] + carry;
-                t[j - 1] = (uint64_t)c2;
-                carry = c2 >> 64;
+                cur = (u128)t[j] + (u128)a.l[i] * b.l[j] + c0;
+                c0 = (uint64_t)(cur >> 64);
+                red += (u128)(uint64_t)cur + (u128)mm * m[j];
+                t[j - 1] = (uint64_t)red;
+                red >>= 64;
             }
-            cur = (u128)t[4] + carry;
-            t[3] = (uint64_t)cur;
-            t[4] = t[5] + (uint64_t)(cur >> 64);
-            t[5] = 0;
+            t[3] = (uint64_t)(red + c0);
         }
         memcpy(out.l, t, 32);
-        if (t[4] || geq_p(out.l)) sub_p(out.l);
+        if (geq_p(out.l)) sub_p(out.l);
     }
 
     static inline void sqr(Fp &out, const Fp &a) { mul(out, a, a); }
@@ -149,6 +143,7 @@ template <typename P> struct Fp {
     }
 
     static void set_one(Fp &out) { memcpy(out.l, P::one(), 32); }
+    static void set_zero(Fp &out) { memset(out.l, 0, 32); }
 
     // out = a^e for a canonical 4-limb exponent (square-and-multiply).
     static void pow(Fp &out, const Fp &a, const uint64_t e[4]) {
@@ -209,7 +204,7 @@ static void bit_reverse_permute(FrF *data, int64_t n) {
 
 extern "C" {
 
-int64_t zk_abi_version() { return 1; }
+int64_t zk_abi_version() { return 2; }
 
 // In-place NTT of `data` (n x 4 canonical limbs).  `root_canon` must be
 // a primitive n-th root of unity (pass the inverse root for the inverse
@@ -266,6 +261,66 @@ void zk_vec_mul(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) 
         FrF::to_mont(y, b + 4 * i);
         FrF::mul(z, x, y);
         FrF::from_mont(out + 4 * i, z);
+    }
+}
+
+// out[i] = base^i (canonical limbs) for i in [0, n).
+void zk_powers(const uint64_t *base_canon, int64_t n, uint64_t *out) {
+    FrF base, acc;
+    FrF::to_mont(base, base_canon);
+    FrF::set_one(acc);
+    for (int64_t i = 0; i < n; ++i) {
+        FrF::from_mont(out + 4 * i, acc);
+        FrF::mul(acc, acc, base);
+    }
+}
+
+// acc[i] += s * p[i] for i in [0, n) — the round-5 linear combination.
+// acc/p are canonical; the product is computed in Montgomery form and
+// converted back before the canonical add.
+void zk_scale_add(uint64_t *acc, const uint64_t *p, const uint64_t *s_canon, int64_t n) {
+    FrF s;
+    FrF::to_mont(s, s_canon);
+    for (int64_t i = 0; i < n; ++i) {
+        FrF x, a, zf, sum;
+        FrF::to_mont(x, p + 4 * i);
+        FrF::mul(zf, x, s);
+        FrF::from_mont(zf.l, zf);
+        memcpy(a.l, acc + 4 * i, 32);
+        FrF::add(sum, a, zf);
+        memcpy(acc + 4 * i, sum.l, 32);
+    }
+}
+
+// Horner evaluation of an n-coefficient polynomial at x (all canonical).
+void zk_poly_eval(const uint64_t *coeffs, int64_t n, const uint64_t *x_canon,
+                  uint64_t *out) {
+    FrF x, acc;
+    FrF::to_mont(x, x_canon);
+    FrF::set_zero(acc);
+    for (int64_t i = n - 1; i >= 0; --i) {
+        FrF c, t;
+        FrF::to_mont(c, coeffs + 4 * i);
+        FrF::mul(t, acc, x);
+        FrF::add(acc, t, c);
+    }
+    FrF::from_mont(out, acc);
+}
+
+// Synthetic division: out (n-1 coeffs) = (p - y) / (X - z); the caller
+// guarantees p(z) == y so the remainder vanishes.
+void zk_div_linear(const uint64_t *coeffs, int64_t n, const uint64_t *z_canon,
+                   uint64_t *out) {
+    FrF z, rem;
+    FrF::to_mont(z, z_canon);
+    FrF::set_zero(rem);
+    for (int64_t i = n - 1; i >= 1; --i) {
+        FrF c, t;
+        FrF::to_mont(c, coeffs + 4 * i);
+        FrF::mul(t, rem, z);
+        // out[i-1] = c + rem*z ... building from the top down:
+        FrF::add(rem, t, c);
+        FrF::from_mont(out + 4 * (i - 1), rem);
     }
 }
 

@@ -415,6 +415,14 @@ class EdwardsChip:
         self.bit = cs.column("ed_bit")
         self.acc = cs.column("ed_acc")
         self.pw = cs.column("ed_pw", "fixed")
+        # Intermediate products of the bbjlp addition (a = z1·z2,
+        # c = x1·x2, d = y1·y2) witnessed per row so the add/select
+        # constraints stay at degree ≤ 6 incl. selector.  Without them
+        # the cleared-denominator x3 polynomial reaches degree 9 and
+        # forces a 16× quotient extension domain on the whole circuit.
+        self.ta = cs.column("ed_ta")
+        self.tc = cs.column("ed_tc")
+        self.td = cs.column("ed_td")
 
         def add_poly(x1, y1, z1, x2, y2, z2):
             a = z1 * z2 % P
@@ -445,19 +453,40 @@ class EdwardsChip:
         self._add_poly = add_poly
         self._double_poly = double_poly
 
+        def add_poly_witnessed(v):
+            """The bbjlp addition of (rx,ry,rz)+(ex,ey,ez) expressed in
+            the witnessed intermediates ta/tc/td: degree ≤ 5 instead of
+            the cleared-denominator degree 8/9."""
+            ta, tc, td = v[self.ta], v[self.tc], v[self.td]
+            b = ta * ta % P
+            e = BJJ_D * tc % P * td % P
+            f = (b - e) % P
+            g = (b + e) % P
+            x3 = ta * f % P * ((v[self.rx] + v[self.ry]) * (v[self.ex] + v[self.ey]) - tc - td) % P
+            y3 = ta * g % P * ((td - BJJ_A * tc) % P) % P
+            z3 = f * g % P
+            return x3, y3, z3
+
+        def intermediate_cons(v):
+            return [
+                (v[self.ta] - v[self.rz] * v[self.ez]) % P,
+                (v[self.tc] - v[self.rx] * v[self.ex]) % P,
+                (v[self.td] - v[self.ry] * v[self.ey]) % P,
+            ]
+
         def mul_step(v):
             bit = v[self.bit]
             ex, ey, ez = v[self.ex], v[self.ey], v[self.ez]
             rx, ry, rz = v[self.rx], v[self.ry], v[self.rz]
             dx, dy, dz = double_poly(ex, ey, ez)
-            ax, ay, az = add_poly(rx, ry, rz, ex, ey, ez)
+            ax, ay, az = add_poly_witnessed(v)
             # select(bit, add, keep) per coordinate
             sel = [
                 (bit * ax + (1 - bit) * rx) % P,
                 (bit * ay + (1 - bit) * ry) % P,
                 (bit * az + (1 - bit) * rz) % P,
             ]
-            return [
+            return intermediate_cons(v) + [
                 bit * bit - bit,
                 (v[self.rx, 1] - sel[0]) % P,
                 (v[self.ry, 1] - sel[1]) % P,
@@ -469,10 +498,8 @@ class EdwardsChip:
             ]
 
         def add_gate(v):
-            ax, ay, az = add_poly(
-                v[self.rx], v[self.ry], v[self.rz], v[self.ex], v[self.ey], v[self.ez]
-            )
-            return [
+            ax, ay, az = add_poly_witnessed(v)
+            return intermediate_cons(v) + [
                 (v[self.rx, 1] - ax) % P,
                 (v[self.ry, 1] - ay) % P,
                 (v[self.rz, 1] - az) % P,
@@ -539,6 +566,9 @@ class EdwardsChip:
                 cs.copy(ez_c, point[2])
             cs.assign(self.acc, row, acc)
             cs.assign(self.pw, row, pow(2, i, P))
+            cs.assign(self.ta, row, rz * ez % P)
+            cs.assign(self.tc, row, rx * ex % P)
+            cs.assign(self.td, row, ry * ey % P)
             cs.enable("ed_mul", row)
             if i == 0:
                 cs.enable("ed_init", row)
@@ -599,6 +629,9 @@ class EdwardsChip:
         ):
             here = cs.assign(col, row, val)
             cs.copy(here, cell)
+        cs.assign(self.ta, row, z1 * z2 % P)
+        cs.assign(self.tc, row, x1 * x2 % P)
+        cs.assign(self.td, row, y1 * y2 % P)
         cs.enable("ed_add", row)
         x3, y3, z3 = self._add_poly(x1, y1, z1, x2, y2, z2)
         cs.assign(self.rx, row + 1, x3)

@@ -157,10 +157,27 @@ class Setup:
 
     # -- commitment scheme ----------------------------------------------
 
-    def commit(self, coeffs: list[int]) -> G1:
-        """Commit to a coefficient-form polynomial."""
+    def commit(self, coeffs) -> G1:
+        """Commit to a coefficient-form polynomial (list of ints or an
+        (n,4) canonical-limb array)."""
+        import numpy as np
+
+        if isinstance(coeffs, np.ndarray):
+            return self.commit_limbs(coeffs)
         assert len(coeffs) <= self.n, "polynomial exceeds SRS degree"
         return msm([c % R for c in coeffs], self.g1_powers)
+
+    def commit_limbs(self, arr) -> G1:
+        """Zero-conversion commitment: (n,4) canonical scalar limbs
+        against a cached limb form of the G1 powers."""
+        from . import native as zk_native
+
+        assert arr.shape[0] <= self.n, "polynomial exceeds SRS degree"
+        cache = getattr(self, "_point_limbs", None)
+        if cache is None:
+            cache = zk_native._points_to_limbs(self.g1_powers)
+            object.__setattr__(self, "_point_limbs", cache)
+        return zk_native.msm_limbs(arr, cache[: arr.shape[0]])
 
     def open(self, coeffs: list[int], z: int) -> tuple[int, G1]:
         """Evaluation y = p(z) and witness commitment W = [(p - y)/(X - z)]."""

@@ -32,6 +32,19 @@ _lib = None  # None = untried, False = failed, else CDLL
 _I64P = ctypes.POINTER(ctypes.c_int64)
 
 
+#: Bump together with zk_abi_version() in native/zk_runtime.cpp whenever
+#: symbols are added or signatures change; _load() rebuilds a stale .so.
+_ABI_VERSION = 2
+
+
+def _rebuild():
+    subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR), "-B", "libzk_runtime.so"],
+        check=True,
+        capture_output=True,
+    )
+
+
 def _load():
     global _lib
     if _lib is False:
@@ -40,15 +53,24 @@ def _load():
         return _lib
     if not _LIB_PATH.exists():
         try:
-            subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR), "libzk_runtime.so"],
-                check=True,
-                capture_output=True,
-            )
+            _rebuild()
         except Exception:
             _lib = False
             raise
     lib = ctypes.CDLL(str(_LIB_PATH))
+    try:
+        lib.zk_abi_version.restype = ctypes.c_int64
+        stale = lib.zk_abi_version() != _ABI_VERSION
+    except AttributeError:
+        stale = True
+    if stale:
+        # A .so from an older checkout: rebuild in place and reload.
+        try:
+            _rebuild()
+        except Exception:
+            _lib = False
+            raise
+        lib = ctypes.CDLL(str(_LIB_PATH))
     lib.zk_ntt.argtypes = [_U64P, ctypes.c_int64, _U64P, ctypes.c_int]
     lib.zk_vec_mul.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
     lib.zk_vec_add.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
@@ -68,8 +90,12 @@ def _load():
         _U64P,
     ]
     lib.zk_eval_program.restype = ctypes.c_int64
+    lib.zk_powers.argtypes = [_U64P, ctypes.c_int64, _U64P]
+    lib.zk_scale_add.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
+    lib.zk_poly_eval.argtypes = [_U64P, ctypes.c_int64, _U64P, _U64P]
+    lib.zk_div_linear.argtypes = [_U64P, ctypes.c_int64, _U64P, _U64P]
     lib.zk_abi_version.restype = ctypes.c_int64
-    assert lib.zk_abi_version() == 1
+    assert lib.zk_abi_version() == _ABI_VERSION
     _lib = lib
     return lib
 
@@ -79,7 +105,7 @@ def available() -> bool:
     try:
         _load()
         return True
-    except (OSError, subprocess.CalledProcessError, AssertionError):
+    except (OSError, subprocess.CalledProcessError, AssertionError, AttributeError):
         _lib = False
         return False
 
@@ -141,6 +167,55 @@ def msm(scalars: list[int], points: list[G1]) -> G1:
     out = np.zeros(8, dtype=np.uint64)
     lib.zk_msm(_ptr(s), _ptr(p), n, _ptr(out))
     return _limbs_to_point(out)
+
+
+def msm_limbs(scalars: np.ndarray, point_limbs: np.ndarray) -> G1:
+    """MSM with (n,4) canonical scalar limbs and pre-converted (n,8)
+    point limbs — the zero-conversion hot path for commitments."""
+    lib = _load()
+    n = scalars.shape[0]
+    s = np.ascontiguousarray(scalars, dtype=np.uint64)
+    out = np.zeros(8, dtype=np.uint64)
+    lib.zk_msm(_ptr(s), _ptr(point_limbs), n, _ptr(out))
+    return _limbs_to_point(out)
+
+
+def powers(base: int, n: int) -> np.ndarray:
+    """(n,4) canonical limbs of base^0 .. base^(n-1)."""
+    lib = _load()
+    b = to_limbs([base % R])
+    out = np.empty((n, 4), dtype=np.uint64)
+    lib.zk_powers(_ptr(b), n, _ptr(out))
+    return out
+
+
+def scale_add(acc: np.ndarray, p: np.ndarray, scalar: int) -> None:
+    """acc[i] += scalar * p[i] over min(len) rows, in place (canonical)."""
+    lib = _load()
+    n = min(acc.shape[0], p.shape[0])
+    s = to_limbs([scalar % R])
+    lib.zk_scale_add(_ptr(acc), _ptr(np.ascontiguousarray(p[:n])), _ptr(s), n)
+
+
+def poly_eval_limbs(coeffs: np.ndarray, x: int) -> int:
+    lib = _load()
+    xl = to_limbs([x % R])
+    out = np.empty(4, dtype=np.uint64)
+    lib.zk_poly_eval(_ptr(np.ascontiguousarray(coeffs)), coeffs.shape[0], _ptr(xl), _ptr(out))
+    return int(out[0]) | int(out[1]) << 64 | int(out[2]) << 128 | int(out[3]) << 192
+
+
+def div_linear_limbs(coeffs: np.ndarray, z: int) -> np.ndarray:
+    """(p - p(z)) / (X - z) on (n,4) canonical limbs -> (n-1,4)."""
+    lib = _load()
+    n = coeffs.shape[0]
+    zl = to_limbs([z % R])
+    out = np.empty((max(n - 1, 1), 4), dtype=np.uint64)
+    if n <= 1:
+        out[:] = 0
+        return out
+    lib.zk_div_linear(_ptr(np.ascontiguousarray(coeffs)), n, _ptr(zl), _ptr(out))
+    return out
 
 
 def srs_g1_powers(tau: int, n: int) -> list[G1]:

@@ -318,6 +318,17 @@ class Domain:
         lib.zk_ntt(_ptr(arr), len(vals), _ptr(rl), 1 if inverse else 0)
         return from_limbs_fast(arr)
 
+    def ifft_arr(self, values: list[int] | np.ndarray) -> np.ndarray:
+        """Interpolate n evaluations into coefficient limbs without a
+        Python-int round trip."""
+        if isinstance(values, np.ndarray):
+            arr = np.ascontiguousarray(values, dtype=np.uint64)
+            assert arr.shape[0] == self.n
+        else:
+            assert len(values) == self.n
+            arr = to_limbs_fast(values)
+        return self.ntt_limbs(arr, self.omega_inv, True)
+
     def ntt_limbs(self, arr: np.ndarray, root: int, inverse: bool) -> np.ndarray:
         """In-place NTT over a (n, 4) limb array (native path)."""
         lib = _native_lib()
@@ -335,6 +346,89 @@ def _powers(base: int, n: int) -> list[int]:
     for i in range(1, n):
         out[i] = out[i - 1] * base % R
     return out
+
+
+# -- limb-array helpers -----------------------------------------------------
+#
+# The proving hot path keeps polynomials as (n, 4) uint64 canonical-limb
+# arrays end to end (ifft -> blind -> commit -> coset -> open), so the
+# per-element Python big-int <-> limb conversions that dominated early
+# profiles only happen at the few scalar boundaries (transcript,
+# challenges, blinders).  Every helper falls back to pure Python via
+# from/to_limbs_fast when the native runtime is unavailable.
+
+
+def _row_int(arr: np.ndarray, i: int) -> int:
+    r = arr[i]
+    return int(r[0]) | int(r[1]) << 64 | int(r[2]) << 128 | int(r[3]) << 192
+
+
+def _set_row(arr: np.ndarray, i: int, v: int) -> None:
+    arr[i, 0] = v & 0xFFFFFFFFFFFFFFFF
+    arr[i, 1] = (v >> 64) & 0xFFFFFFFFFFFFFFFF
+    arr[i, 2] = (v >> 128) & 0xFFFFFFFFFFFFFFFF
+    arr[i, 3] = (v >> 192) & 0xFFFFFFFFFFFFFFFF
+
+
+def _powers_arr(base: int, n: int) -> np.ndarray:
+    lib = _native_lib()
+    if lib is None:
+        return to_limbs_fast(_powers(base % R, n))
+    from .native import powers as native_powers
+
+    return native_powers(base, n)
+
+
+def _poly_eval_arr(arr: np.ndarray, x: int) -> int:
+    lib = _native_lib()
+    if lib is None:
+        acc = 0
+        for c in reversed(from_limbs_fast(arr)):
+            acc = (acc * x + c) % R
+        return acc
+    from .native import poly_eval_limbs
+
+    return poly_eval_limbs(arr, x)
+
+
+def _div_linear_arr(arr: np.ndarray, z: int) -> np.ndarray:
+    """(p - p(z)) / (X - z) on limb arrays."""
+    lib = _native_lib()
+    if lib is None:
+        coeffs = from_limbs_fast(arr)
+        out = [0] * (len(coeffs) - 1)
+        rem = 0
+        for i in range(len(coeffs) - 1, 0, -1):
+            rem = (rem * z + coeffs[i]) % R
+            out[i - 1] = rem
+        return to_limbs_fast(out) if out else np.zeros((1, 4), np.uint64)
+    from .native import div_linear_limbs
+
+    return div_linear_limbs(arr, z)
+
+
+def _scale_add_arr(acc: np.ndarray, p: np.ndarray, s: int) -> None:
+    lib = _native_lib()
+    if lib is None:
+        n = min(acc.shape[0], p.shape[0])
+        av = from_limbs_fast(acc[:n])
+        pv = from_limbs_fast(p[:n])
+        acc[:n] = to_limbs_fast([(a + s * b) % R for a, b in zip(av, pv)])
+        return
+    from .native import scale_add
+
+    scale_add(acc, p, s)
+
+
+def _vec_mul_arr(a: np.ndarray, b: np.ndarray, out: np.ndarray) -> None:
+    """out = a * b elementwise over min-length rows (canonical limbs)."""
+    lib = _native_lib()
+    n = min(a.shape[0], b.shape[0], out.shape[0])
+    if lib is None:
+        av, bv = from_limbs_fast(a[:n]), from_limbs_fast(b[:n])
+        out[:n] = to_limbs_fast([(x * y) % R for x, y in zip(av, bv)])
+        return
+    lib.zk_vec_mul(_ptr(a[:n]), _ptr(b[:n]), _ptr(out[:n]), n)
 
 
 def _batch_inv(vals: list[int]) -> list[int]:
@@ -445,17 +539,26 @@ class VerifyingKey:
 class ProvingKey:
     vk: VerifyingKey
     fixed_values: list[list[int]]  # n evals per fixed column
-    fixed_polys: list[list[int]]
+    fixed_polys: list[np.ndarray]  # (n,4) canonical coefficient limbs
     sigma_values: list[list[int]]  # permutation tags sigma_j(w^i)
-    sigma_polys: list[list[int]]
+    sigma_polys: list[np.ndarray]
     row_tags: list[int]  # omega^i, i < n
+    #: Coset-extended evaluations of every fixed/sigma polynomial,
+    #: precomputed at keygen so epoch proving never re-runs their
+    #: coset NTTs (they are witness-independent).
+    fixed_cosets: list[np.ndarray] = dc_field(default_factory=list)
+    sigma_cosets: list[np.ndarray] = dc_field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
 # Compilation (keygen)
 # ---------------------------------------------------------------------------
 
-_M_CHUNK = 6  # permutation columns per grand product (degree m+2 each)
+# Permutation columns per grand product (degree _M_CHUNK+2 each).  5
+# keeps the permutation at degree 7, matching the worst gate (ed_mul
+# select at 6 + selector), so the quotient extension stays at 8× —
+# one more z polynomial in exchange for half the extended domain.
+_M_CHUNK = 5
 
 
 def _classify_columns(cs: ConstraintSystem):
@@ -607,7 +710,7 @@ def compile_circuit(
     for cols_vals in lookup_tables:
         fixed_values.extend(cols_vals)
     assert len(fixed_values) == len(names_fix)
-    fixed_polys = [domain.ifft(v) for v in fixed_values]
+    fixed_polys = [domain.ifft_arr(v) for v in fixed_values]
 
     # Permutation mapping sigma: identity tags, then rewire cycles.
     row_tags = _powers(domain.omega, n)
@@ -645,7 +748,7 @@ def compile_circuit(
         for i, (j, row) in enumerate(members):
             nj, nrow = members[(i + 1) % len(members)]
             sigma_values[j][row] = perm_tags[nj] * row_tags[nrow] % R
-    sigma_polys = [domain.ifft(v) for v in sigma_values]
+    sigma_polys = [domain.ifft_arr(v) for v in sigma_values]
 
     if srs is None:
         # Fresh random tau, discarded after the ladder is built: the
@@ -685,6 +788,12 @@ def compile_circuit(
         lookups=lookup_specs,
     )
     vk.digest = vk.compute_digest()
+    # Precompute coset-extended evaluations of the witness-independent
+    # polynomials so prove() never re-runs their coset NTTs.
+    ev = _CosetEvaluator(k, ext_factor)
+    fixed_cosets = [ev._coset_fft(p) for p in fixed_polys]
+    sigma_cosets = [ev._coset_fft(p) for p in sigma_polys]
+
     return ProvingKey(
         vk=vk,
         fixed_values=fixed_values,
@@ -692,6 +801,8 @@ def compile_circuit(
         sigma_values=sigma_values,
         sigma_polys=sigma_polys,
         row_tags=row_tags,
+        fixed_cosets=fixed_cosets,
+        sigma_cosets=sigma_cosets,
     )
 
 
@@ -893,22 +1004,23 @@ class _CosetEvaluator:
         self.ext = Domain(self.ext_k)
         self.shift = DELTA
         self._arrays: dict[int, np.ndarray] = {}
-        self._coeffs: dict[int, list[int]] = {}
-        self._shift_pows: list[int] | None = None
+        self._coeffs: dict[int, np.ndarray] = {}
+        self._shift_pows: np.ndarray | None = None
 
-    def set_coeffs(self, slot: int, coeffs: list[int]) -> None:
+    def set_coeffs(self, slot: int, coeffs: list[int] | np.ndarray) -> None:
+        if not isinstance(coeffs, np.ndarray):
+            coeffs = to_limbs_fast(coeffs)
         self._coeffs[slot] = coeffs
 
     def set_values_ext(self, slot: int, arr: np.ndarray) -> None:
         self._arrays[slot] = arr
 
-    def _coset_fft(self, coeffs: list[int]) -> np.ndarray:
+    def _coset_fft(self, coeffs: np.ndarray) -> np.ndarray:
         if self._shift_pows is None:
-            self._shift_pows = _powers(self.shift, self.m)
-        sp = self._shift_pows
-        scaled = [c * sp[i] % R for i, c in enumerate(coeffs)]
-        scaled += [0] * (self.m - len(scaled))
-        arr = to_limbs_fast(scaled)
+            self._shift_pows = _powers_arr(self.shift, self.m)
+        arr = np.zeros((self.m, 4), dtype=np.uint64)
+        arr[: coeffs.shape[0]] = coeffs
+        _vec_mul_arr(arr, self._shift_pows, arr)
         return self.ext.ntt_limbs(arr, self.ext.omega, False)
 
     def array(self, slot: int) -> np.ndarray:
@@ -997,16 +1109,19 @@ def prove(
 
     rng = secrets.SystemRandom() if seed is None else __import__("random").Random(seed)
 
-    def blind(coeffs: list[int], n_blind: int) -> list[int]:
+    def blind(coeffs: np.ndarray, n_blind: int) -> np.ndarray:
         """p + r(X)·Z_H with r random of n_blind coefficients.  The mask
         vanishes on the domain, so constraints are untouched; n_blind
         must be ≥ the number of rotations the polynomial is opened at,
-        or the revealed evaluations over-determine the mask."""
-        bs = [rng.randrange(R) for _ in range(n_blind)]
-        out = list(coeffs) + [0] * (n + n_blind - len(coeffs))
-        for i, b in enumerate(bs):
-            out[i] = (out[i] - b) % R
-            out[n + i] = (out[n + i] + b) % R
+        or the revealed evaluations over-determine the mask.  Operates
+        on (len, 4) canonical-limb arrays; only the 2·n_blind touched
+        rows round-trip through Python ints."""
+        out = np.zeros((n + n_blind, 4), dtype=np.uint64)
+        out[: coeffs.shape[0]] = coeffs
+        for i in range(n_blind):
+            b = rng.randrange(R)
+            _set_row(out, i, (_row_int(out, i) - b) % R)
+            _set_row(out, n + i, (_row_int(out, n + i) + b) % R)
         return out
 
     # Column value tables (n evals).
@@ -1041,7 +1156,7 @@ def prove(
     # than the number of opening points, so derive the count from the
     # rotations each column is actually opened at instead of assuming 2.
     advice_polys = [
-        blind(domain.ifft(v), len(vk.gate_rots.get(i, ())) + 1)
+        blind(domain.ifft_arr(v), len(vk.gate_rots.get(i, ())) + 1)
         for i, v in enumerate(advice_values)
     ]
     for p in advice_polys:
@@ -1095,8 +1210,8 @@ def prove(
         lk_t_vals.append(t_comp)
         lk_ap_vals.append(a_sorted + [0])
         lk_sp_vals.append(list(s_prime) + [0])
-        ap_poly = blind(domain.ifft(a_sorted + [0]), 3)
-        sp_poly = blind(domain.ifft(list(s_prime) + [0]), 3)
+        ap_poly = blind(domain.ifft_arr(a_sorted + [0]), 3)
+        sp_poly = blind(domain.ifft_arr(list(s_prime) + [0]), 3)
         lk_ap_polys.append(ap_poly)
         lk_sp_polys.append(sp_poly)
         transcript.write_point(srs.commit(ap_poly))
@@ -1125,7 +1240,7 @@ def prove(
         start = z[n - 1]
         z_values.append(z)
         # z is opened at up to 3 rotations (−1, 0, 1); 4 blinders.
-        z_polys.append(blind(domain.ifft(z), 4))
+        z_polys.append(blind(domain.ifft_arr(z), 4))
     if vk.chunks:
         assert start == 1, "permutation product != 1 (copy constraints broken?)"
     for p in z_polys:
@@ -1146,7 +1261,7 @@ def prove(
             num = (a_comp[i] + beta) % R * ((t_comp[i] + gamma) % R) % R
             z[i + 1] = z[i] * num % R * den_inv[i] % R
         assert z[n - 1] == 1, "lookup product != 1 (input not a table subset?)"
-        lk_z_polys.append(blind(domain.ifft(z), 3))
+        lk_z_polys.append(blind(domain.ifft_arr(z), 3))
         transcript.write_point(srs.commit(lk_z_polys[-1]))
     y = transcript.squeeze_challenge()
 
@@ -1166,22 +1281,31 @@ def prove(
     for i, p in enumerate(advice_polys):
         ev.set_coeffs(i, p)
     for i, vals in enumerate(instance_values):
-        ev.set_coeffs(n_adv + i, domain.ifft(vals))
-    for i, p in enumerate(pk.fixed_polys):
-        ev.set_coeffs(n_adv + n_inst + i, p)
-    for j, p in enumerate(pk.sigma_polys):
-        ev.set_coeffs(sigma_slots[j], p)
+        ev.set_coeffs(n_adv + i, domain.ifft_arr(vals))
+    for i in range(len(pk.fixed_polys)):
+        if pk.fixed_cosets:
+            ev.set_values_ext(n_adv + n_inst + i, pk.fixed_cosets[i])
+        else:
+            ev.set_coeffs(n_adv + n_inst + i, pk.fixed_polys[i])
+    for j in range(len(pk.sigma_polys)):
+        if pk.sigma_cosets:
+            ev.set_values_ext(sigma_slots[j], pk.sigma_cosets[j])
+        else:
+            ev.set_coeffs(sigma_slots[j], pk.sigma_polys[j])
     for c, p in enumerate(z_polys):
         ev.set_coeffs(z_slots[c], p)
     # Aux columns: X, l0, l_last on the coset.
     m = ev.m
-    x_vals = [ev.shift * wi % R for wi in _powers(ev.ext.omega, m)]
-    ev.set_values_ext(x_slot, to_limbs_fast(x_vals))
+    x_arr = _powers_arr(ev.ext.omega, m)
+    shift_arr = np.broadcast_to(to_limbs([ev.shift]), (m, 4))
+    x_out = np.empty((m, 4), dtype=np.uint64)
+    _vec_mul_arr(x_arr, np.ascontiguousarray(shift_arr), x_out)
+    ev.set_values_ext(x_slot, x_out)
     e0, elast = [0] * n, [0] * n
     e0[0] = 1
     elast[n - 1] = 1
-    ev.set_coeffs(l0_slot, domain.ifft(e0))
-    ev.set_coeffs(llast_slot, domain.ifft(elast))
+    ev.set_coeffs(l0_slot, domain.ifft_arr(e0))
+    ev.set_coeffs(llast_slot, domain.ifft_arr(elast))
     for i in range(n_lk):
         ev.set_coeffs(lk_a_slots[i], lk_ap_polys[i])
         ev.set_coeffs(lk_s_slots[i], lk_sp_polys[i])
@@ -1239,32 +1363,25 @@ def prove(
     ]
     zh_inv = _batch_inv(zh_period)
     zh_tile = to_limbs_fast([zh_inv[i % E] for i in range(m)])
-    lib = _native_lib()
-    if lib is not None and acc is not None:
-        lib.zk_vec_mul(_ptr(acc), _ptr(zh_tile), _ptr(acc), m)
-        t_arr = ev.ext.ntt_limbs(acc, ev.ext.omega_inv, True)
-        t_scaled = from_limbs_fast(t_arr)
-    else:
-        vals = from_limbs_fast(acc) if acc is not None else [0] * m
-        vals = [v * zh_inv[i % E] % R for i, v in enumerate(vals)]
-        t_scaled = ev.ext.ifft(vals)
+    if acc is None:
+        acc = np.zeros((m, 4), dtype=np.uint64)
+    _vec_mul_arr(acc, zh_tile, acc)
+    t_arr = ev.ext.ntt_limbs(acc, ev.ext.omega_inv, True)
     shift_inv = pow(ev.shift, R - 2, R)
-    sp = _powers(shift_inv, m)
-    t_coeffs = [c * sp[i] % R for i, c in enumerate(t_scaled)]
-    while t_coeffs and t_coeffs[-1] == 0:
-        t_coeffs.pop()
-    if not t_coeffs:
-        t_coeffs = [0]
-    t_chunks = [t_coeffs[i : i + n] for i in range(0, len(t_coeffs), n)]
+    sp_arr = _powers_arr(shift_inv, m)
+    _vec_mul_arr(t_arr, sp_arr, t_arr)
+    nz = np.nonzero(t_arr.any(axis=1))[0]
+    t_limbs = t_arr[: int(nz[-1]) + 1] if nz.size else t_arr[:1]
+    t_chunks = [t_limbs[i : i + n] for i in range(0, t_limbs.shape[0], n)]
     for chunk in t_chunks:
-        transcript.write_point(srs.commit(chunk))
+        transcript.write_point(srs.commit(np.ascontiguousarray(chunk)))
     x = transcript.squeeze_challenge()
 
     # Round 4: evaluations.
     entries = _opening_entries(vk, len(t_chunks))
     w = domain.omega
 
-    def poly_of(kind: str, idx: int) -> list[int]:
+    def poly_of(kind: str, idx: int) -> np.ndarray:
         if kind == "advice":
             return advice_polys[idx]
         if kind == "fixed":
@@ -1290,7 +1407,7 @@ def prove(
                 if rot >= 0
                 else x * pow(domain.omega_inv, -rot, R) % R
             )
-            val = _eval_poly(p, pt)
+            val = _poly_eval_arr(p, pt)
             evals[(kind, idx, rot)] = val
             transcript.write_scalar(val)
     v = transcript.squeeze_challenge()
@@ -1303,20 +1420,14 @@ def prove(
             if rot >= 0
             else x * pow(domain.omega_inv, -rot, R) % R
         )
-        agg: list[int] = []
-        agg_y = 0
+        group = [e for e in entries if rot in e[2]]
+        max_len = max(poly_of(k, i).shape[0] for k, i, _ in group)
+        agg = np.zeros((max_len, 4), dtype=np.uint64)
         v_pow = 1
-        for kind, idx, rots in entries:
-            if rot not in rots:
-                continue
-            p = poly_of(kind, idx)
-            if len(p) > len(agg):
-                agg += [0] * (len(p) - len(agg))
-            for i, c in enumerate(p):
-                agg[i] = (agg[i] + v_pow * c) % R
-            agg_y = (agg_y + v_pow * evals[(kind, idx, rot)]) % R
+        for kind, idx, _rots in group:
+            _scale_add_arr(agg, poly_of(kind, idx), v_pow)
             v_pow = v_pow * v % R
-        witness = _div_by_linear(agg, pt, agg_y)
+        witness = _div_linear_arr(agg, pt)
         transcript.write_point(srs.commit(witness))
 
     return transcript.finalize()
