@@ -650,7 +650,7 @@ void zk_srs_powers(const uint64_t *tau, int64_t n, uint64_t *out) {
 //   5           neg
 // Output: m x 4 canonical.
 
-static const int ZK_EVAL_STACK = 64;
+static const int ZK_EVAL_STACK = 160;
 
 // Pre-pass: simulate stack depth and bounds-check every operand so a
 // malformed program can't overflow the per-thread stack or index out of

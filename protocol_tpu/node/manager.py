@@ -57,27 +57,16 @@ class Manager:
 
     def __init__(self, config: ManagerConfig | None = None, prover: Prover | None = None):
         self.config = config or ManagerConfig()
-        if prover is None:
-            if self.config.prover == "plonk":
-                # Boot-time keygen, like the reference's MANAGER_STORE
-                # init (server/src/main.rs:70-83).
-                from ..zk.proof import PlonkEpochProver
-
-                prover = PlonkEpochProver(
-                    num_neighbours=self.config.num_neighbours,
-                    num_iter=self.config.num_iter,
-                    initial_score=self.config.initial_score,
-                    scale=self.config.scale,
-                    srs_path=self.config.srs_path,
-                )
-            elif self.config.prover == "commitment":
-                prover = PoseidonCommitmentProver()
-            else:
-                raise ValueError(
-                    f"unknown prover {self.config.prover!r}: "
-                    "expected 'commitment' or 'plonk'"
-                )
-        self.prover = prover
+        if prover is None and self.config.prover not in ("plonk", "commitment"):
+            raise ValueError(
+                f"unknown prover {self.config.prover!r}: "
+                "expected 'commitment' or 'plonk'"
+            )
+        # Lazy: PLONK keygen is ~20 s, so it runs on first use (or
+        # explicitly via warm_prover() at node boot, the analog of the
+        # reference's MANAGER_STORE init, server/src/main.rs:70-83)
+        # rather than on every Manager construction.
+        self._prover = prover
         self.cached_proofs: dict[Epoch, Proof] = {}
         self.attestations: dict[int, Attestation] = {}
         self.cached_results: dict[Epoch, ConvergenceResult] = {}
@@ -90,6 +79,28 @@ class Manager:
         self._hash_cache: dict[PublicKey, int] = dict(
             zip(self._group_pks, self._group_hashes)
         )
+
+    @property
+    def prover(self) -> Prover:
+        if self._prover is None:
+            if self.config.prover == "plonk":
+                from ..zk.proof import PlonkEpochProver
+
+                self._prover = PlonkEpochProver(
+                    num_neighbours=self.config.num_neighbours,
+                    num_iter=self.config.num_iter,
+                    initial_score=self.config.initial_score,
+                    scale=self.config.scale,
+                    srs_path=self.config.srs_path,
+                )
+            else:
+                self._prover = PoseidonCommitmentProver()
+        return self._prover
+
+    def warm_prover(self) -> None:
+        """Force prover construction (PLONK keygen) now — called at node
+        boot so the first epoch tick doesn't pay it."""
+        _ = self.prover
 
     def _pk_hash(self, pk: PublicKey) -> int:
         h = self._hash_cache.get(pk)
