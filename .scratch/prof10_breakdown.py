@@ -1,0 +1,51 @@
+"""Chained component breakdown of power_step_csr at full bench scale
+(50M edges, 1M peers): where do 447 ms/iter actually go?"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+
+from protocol_tpu.ops.sparse import rowsum_sorted, power_step_csr, _ds_cumsum_axis1, _compensated_cumsum
+
+rng = np.random.default_rng(0)
+E, N = 50_000_000, 1_000_000
+REPS = 8
+
+t_full = jax.device_put(jnp.asarray(rng.random(N, dtype=np.float32)))
+src = jax.device_put(jnp.asarray(rng.integers(0, N, E).astype(np.int32)))
+w = jax.device_put(jnp.asarray(rng.random(E, dtype=np.float32)))
+contrib = jax.device_put(jnp.asarray(rng.random(E, dtype=np.float32)))
+row_ptr = jax.device_put(jnp.asarray(
+    np.searchsorted(np.sort(rng.integers(0, N, E)), np.arange(N + 1)).astype(np.int32)))
+p = jax.device_put(jnp.full(N, 1.0 / N, np.float32))
+dang = jax.device_put(jnp.zeros(N, np.float32))
+
+
+def timeit(name, fn, *args, reps=2):
+    f = jax.jit(fn)
+    r = np.asarray(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = np.asarray(f(*args))
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name}: {dt/REPS*1e3:.1f} ms/iter  ({dt*1e3:.0f} ms for {REPS})", flush=True)
+
+
+def chain(body):
+    def run(*args):
+        def step(_, acc):
+            return body(acc, *args)
+        return lax.fori_loop(0, REPS, step, jnp.float32(0))
+    return run
+
+timeit("gather t[src]", chain(lambda acc, t, s: acc + t[s].sum()), t_full, src)
+timeit("w*t[src]", chain(lambda acc, t, s, w: acc + (w * t[s]).sum()), t_full, src, w)
+timeit("rowsum_sorted", chain(lambda acc, c, rp: acc + rowsum_sorted(c, rp).sum()), contrib, row_ptr)
+timeit("ds_cumsum blocks only", chain(
+    lambda acc, c: acc + _ds_cumsum_axis1(c.reshape(-1, 2048))[0][:, -1].sum()), contrib)
+timeit("full power_step_csr", chain(
+    lambda acc, s, rp, w, t, p, d: acc + power_step_csr(s, rp, w, t, p, d, 0.1).sum()),
+    src, row_ptr, w, t_full, p, dang)
+timeit("gather+rowsum (no step extras)", chain(
+    lambda acc, t, s, w, rp: acc + rowsum_sorted(w * t[s], rp).sum()),
+    t_full, src, w, row_ptr)

@@ -1,0 +1,82 @@
+"""LICM-defeated component breakdown + in-register primitive costs.
+
+Every loop body depends on the carry so WhileLoopInvariantCodeMotion
+cannot hoist the op being measured.
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from protocol_tpu.ops.sparse import rowsum_sorted
+
+rng = np.random.default_rng(0)
+E, N = 50_000_000, 1_000_000
+REPS = 8
+
+
+def timeit(name, fn, *args, reps=2, per=REPS):
+    f = jax.jit(fn)
+    r = np.asarray(jax.tree.leaves(f(*args))[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = np.asarray(jax.tree.leaves(f(*args))[0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name}: {dt/per*1e3:.2f} ms/iter  ({dt*1e3:.0f} ms total)", flush=True)
+
+
+t_full = jax.device_put(jnp.asarray(rng.random(N, dtype=np.float32)))
+src = jax.device_put(jnp.asarray(rng.integers(0, N, E).astype(np.int32)))
+w = jax.device_put(jnp.asarray(rng.random(E, dtype=np.float32)))
+contrib = jax.device_put(jnp.asarray(rng.random(E, dtype=np.float32)))
+row_ptr = jax.device_put(jnp.asarray(
+    np.searchsorted(np.sort(rng.integers(0, N, E)), np.arange(N + 1)).astype(np.int32)))
+
+EPS = jnp.float32(1e-38)
+
+def dep_chain(body):
+    """body(x_perturbed, *args) -> array; carry a scalar that perturbs
+    the input each iteration so nothing is loop-invariant."""
+    def run(*args):
+        def step(_, acc):
+            return body(acc * EPS, *args)
+        return lax.fori_loop(0, REPS, step, jnp.float32(0))
+    return run
+
+timeit("gather 50M (dep)", dep_chain(lambda d, t, s: (t + d)[s].max()), t_full, src)
+timeit("w*gather 50M (dep)", dep_chain(lambda d, t, s, w: (w * (t + d)[s]).max()), t_full, src, w)
+timeit("rowsum_sorted 50M (dep)", dep_chain(
+    lambda d, c, rp: rowsum_sorted(c + d, rp).max()), contrib, row_ptr)
+timeit("50M elementwise mul (dep)", dep_chain(lambda d, c, w: ((c + d) * w).max()), contrib, w)
+
+# in-register primitive costs: K chained gathers on one vreg inside a kernel
+K = 512
+idxc = jax.device_put(jnp.asarray(rng.integers(0, 128, (8, 128)).astype(np.int32)))
+
+def k_lane(i_ref, o_ref):
+    x = i_ref[:]
+    for _ in range(K):
+        x = jnp.take_along_axis(idx_tbl, x, axis=1)
+    o_ref[:] = x
+
+idx_tbl_np = rng.integers(0, 128, (8, 128)).astype(np.int32)
+idx_tbl = jnp.asarray(idx_tbl_np)
+
+lane_k = pl.pallas_call(k_lane, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))
+try:
+    timeit(f"lane-gather x{K} on one vreg", lambda i: lane_k(i), idxc, per=K, reps=3)
+except Exception as e:
+    print(f"lane chain: FAILED {type(e).__name__}: {str(e).splitlines()[0][:160]}", flush=True)
+
+def k_sub(i_ref, o_ref):
+    x = i_ref[:]
+    for _ in range(K):
+        x = jnp.take_along_axis(idx_tbl8, x % 8, axis=0)
+    o_ref[:] = x
+
+idx_tbl8 = jnp.asarray(rng.integers(0, 128, (8, 128)).astype(np.int32))
+sub_k = pl.pallas_call(k_sub, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))
+try:
+    timeit(f"sublane-gather x{K} on one vreg", lambda i: sub_k(i), idxc, per=K, reps=3)
+except Exception as e:
+    print(f"sublane chain: FAILED {type(e).__name__}: {str(e).splitlines()[0][:160]}", flush=True)

@@ -1,0 +1,121 @@
+"""Micro-bench tpu.dynamic_gather via Pallas take_along_axis with the
+supported same-shape (8192,128) form, both axes, plus full-scale XLA
+component timings for one converge_csr step (gather / rowsum / step).
+"""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R, L = 8192, 128  # one block = 1M elements
+N = R * L
+
+
+def bench(name, fn, *args, reps=10):
+    try:
+        r = jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = jax.block_until_ready(fn(*args))
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name}: {dt*1e3:.3f} ms", flush=True)
+        return r, dt
+    except Exception as e:
+        s = str(e).splitlines()
+        print(f"{name}: FAILED — {type(e).__name__}: {s[0][:160] if s else ''}", flush=True)
+        return None, None
+
+
+rng = np.random.default_rng(0)
+t2d = jax.device_put(jnp.asarray(rng.random((R, L), dtype=np.float32)))
+
+# ---- single-block kernels: gather axis0 (sublane) and axis1 (lane) ----
+def k_ax0(t_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(t_ref[:], i_ref[:], axis=0)
+
+def k_ax1(t_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(t_ref[:], i_ref[:], axis=1)
+
+idx0 = jax.device_put(jnp.asarray(rng.integers(0, R, (R, L)).astype(np.int32)))
+idx1 = jax.device_put(jnp.asarray(rng.integers(0, L, (R, L)).astype(np.int32)))
+
+one = pl.pallas_call(
+    k_ax0,
+    out_shape=jax.ShapeDtypeStruct((R, L), jnp.float32),
+)
+r, dt = bench("pallas dynamic_gather axis0, 1M elems single call", jax.jit(one), t2d, idx0)
+if r is not None:
+    expect = np.asarray(t2d)[np.asarray(idx0), np.arange(L)[None, :]]
+    print("  correct:", bool(np.array_equal(np.asarray(r), expect)), flush=True)
+
+one1 = pl.pallas_call(
+    k_ax1,
+    out_shape=jax.ShapeDtypeStruct((R, L), jnp.float32),
+)
+r, dt = bench("pallas dynamic_gather axis1, 1M elems single call", jax.jit(one1), t2d, idx1)
+if r is not None:
+    expect = np.asarray(t2d)[np.arange(R)[:, None], np.asarray(idx1)]
+    print("  correct:", bool(np.array_equal(np.asarray(r), expect)), flush=True)
+
+# ---- streamed: 48 blocks (49M edges), table pinned, idx streamed ----
+B = 48
+idx_big = jax.device_put(jnp.asarray(rng.integers(0, R, (B * R, L)).astype(np.int32)))
+w_big = jax.device_put(jnp.asarray(rng.random((B * R, L), dtype=np.float32)))
+
+def k_stream(t_ref, i_ref, w_ref, o_ref):
+    o_ref[:] = w_ref[:] * jnp.take_along_axis(t_ref[:], i_ref[:], axis=0)
+
+stream = pl.pallas_call(
+    k_stream,
+    grid=(B,),
+    in_specs=[
+        pl.BlockSpec((R, L), lambda i: (0, 0)),
+        pl.BlockSpec((R, L), lambda i: (i, 0)),
+        pl.BlockSpec((R, L), lambda i: (i, 0)),
+    ],
+    out_specs=pl.BlockSpec((R, L), lambda i: (i, 0)),
+    out_shape=jax.ShapeDtypeStruct((B * R, L), jnp.float32),
+)
+bench(f"pallas streamed gather*w, {B}M edges", jax.jit(stream), t2d, idx_big, w_big)
+
+# ---- 5-gather chain per block (window+lane+3-stage permute estimate) ----
+def k_chain(t_ref, i_ref, w_ref, o_ref):
+    x = jnp.take_along_axis(t_ref[:], i_ref[:], axis=0)
+    x = jnp.take_along_axis(x, i_ref[:] % L, axis=1)
+    x = jnp.take_along_axis(x, i_ref[:], axis=0)
+    x = jnp.take_along_axis(x, i_ref[:] % L, axis=1)
+    x = jnp.take_along_axis(x, i_ref[:], axis=0)
+    o_ref[:] = w_ref[:] * x
+
+chain = pl.pallas_call(
+    k_chain,
+    grid=(B,),
+    in_specs=[
+        pl.BlockSpec((R, L), lambda i: (0, 0)),
+        pl.BlockSpec((R, L), lambda i: (i, 0)),
+        pl.BlockSpec((R, L), lambda i: (i, 0)),
+    ],
+    out_specs=pl.BlockSpec((R, L), lambda i: (i, 0)),
+    out_shape=jax.ShapeDtypeStruct((B * R, L), jnp.float32),
+)
+bench(f"pallas 5-gather chain, {B}M edges", jax.jit(chain), t2d, idx_big, w_big)
+
+# ---- XLA full-scale components ----
+E = 50_000_000
+Nfull = 1_000_000
+t_full = jax.device_put(jnp.asarray(rng.random(Nfull, dtype=np.float32)))
+src = jax.device_put(jnp.asarray(rng.integers(0, Nfull, E).astype(np.int32)))
+w = jax.device_put(jnp.asarray(rng.random(E, dtype=np.float32)))
+bench("XLA gather 50M from 1M table", jax.jit(lambda t, s, w: (w * t[s]).max()), t_full, src, w, reps=3)
+
+from protocol_tpu.ops.sparse import rowsum_sorted, power_step_csr
+contrib = jax.device_put(jnp.asarray(rng.random(E, dtype=np.float32)))
+row_ptr = jax.device_put(jnp.asarray(
+    np.searchsorted(np.sort(rng.integers(0, Nfull, E)), np.arange(Nfull + 1)).astype(np.int32)))
+bench("XLA rowsum_sorted 50M->1M", jax.jit(lambda c, rp: rowsum_sorted(c, rp).max()), contrib, row_ptr, reps=3)
+
+p = jax.device_put(jnp.full(Nfull, 1.0 / Nfull, np.float32))
+dang = jax.device_put(jnp.zeros(Nfull, np.float32))
+bench("XLA power_step_csr full scale", jax.jit(
+    lambda s, rp, w, t, p, d: power_step_csr(s, rp, w, t, p, d, 0.1).max()),
+    src, row_ptr, w, t_full, p, dang, reps=3)

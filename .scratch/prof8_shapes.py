@@ -1,0 +1,80 @@
+"""Probe dynamic_gather support envelope: axis1 (lane) range scaling,
+axis0 (sublane) shapes, transpose support, small-table XLA gather."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+
+rng = np.random.default_rng(0)
+
+
+def bench_gather(axis, R, L, rng_hi=None, reps=20):
+    rng_hi = rng_hi if rng_hi is not None else (R if axis == 0 else L)
+    name = f"axis{axis} ({R},{L}) range={rng_hi}"
+    try:
+        t = jax.device_put(jnp.asarray(rng.random((R, L), dtype=np.float32)))
+        if axis == 0:
+            idx = rng.integers(0, rng_hi, (R, L)).astype(np.int32)
+        else:
+            idx = rng.integers(0, rng_hi, (R, L)).astype(np.int32)
+        idx = jax.device_put(jnp.asarray(idx))
+        f = jax.jit(pl.pallas_call(
+            lambda t_ref, i_ref, o_ref: o_ref.__setitem__(
+                slice(None), jnp.take_along_axis(t_ref[:], i_ref[:], axis=axis)),
+            out_shape=jax.ShapeDtypeStruct((R, L), jnp.float32),
+        ))
+        r = jax.block_until_ready(f(t, idx))
+        tn, ixn = np.asarray(t), np.asarray(idx)
+        if axis == 0:
+            exp = tn[ixn, np.arange(L)[None, :]]
+        else:
+            exp = tn[np.arange(R)[:, None], ixn]
+        ok = np.array_equal(np.asarray(r), exp)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = jax.block_until_ready(f(t, idx))
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name}: {dt*1e6:.1f} us  ({R*L/dt/1e9:.1f} Gelem/s)  correct={ok}", flush=True)
+    except Exception as e:
+        s = str(e).splitlines()
+        print(f"{name}: FAILED — {type(e).__name__}: {s[0][:120] if s else ''}", flush=True)
+
+
+print("== axis1 (lane gather) range scaling ==", flush=True)
+for R, L in [(8192, 128), (1024, 1024), (128, 8192), (64, 16384), (8, 131072), (8, 1048576)]:
+    bench_gather(1, R, L)
+
+print("== axis0 (sublane gather) shapes ==", flush=True)
+for R, L in [(8, 128), (64, 128), (256, 128), (1024, 128), (8192, 128)]:
+    bench_gather(0, R, L)
+
+print("== in-kernel transpose ==", flush=True)
+for R, L in [(128, 8192), (8192, 128)]:
+    try:
+        t = jax.device_put(jnp.asarray(rng.random((R, L), dtype=np.float32)))
+        f = jax.jit(pl.pallas_call(
+            lambda t_ref, o_ref: o_ref.__setitem__(slice(None), t_ref[:].T),
+            out_shape=jax.ShapeDtypeStruct((L, R), jnp.float32),
+        ))
+        r = jax.block_until_ready(f(t))
+        ok = np.array_equal(np.asarray(r), np.asarray(t).T)
+        t0 = time.perf_counter()
+        for _ in range(20):
+            r = jax.block_until_ready(f(t))
+        dt = (time.perf_counter() - t0) / 20
+        print(f"transpose ({R},{L}): {dt*1e6:.1f} us  correct={ok}", flush=True)
+    except Exception as e:
+        s = str(e).splitlines()
+        print(f"transpose ({R},{L}): FAILED — {type(e).__name__}: {s[0][:120] if s else ''}", flush=True)
+
+print("== XLA gather vs table size (8M indices) ==", flush=True)
+E = 8_000_000
+for tbl in [16384, 131072, 1048576]:
+    t = jax.device_put(jnp.asarray(rng.random(tbl, dtype=np.float32)))
+    idx = jax.device_put(jnp.asarray(rng.integers(0, tbl, E).astype(np.int32)))
+    f = jax.jit(lambda t, i: t[i].max())
+    float(f(t, idx))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(f(t, idx))
+    dt = (time.perf_counter() - t0) / 3
+    print(f"XLA gather 8M from {tbl}: {dt*1e3:.2f} ms", flush=True)

@@ -1,0 +1,117 @@
+"""Clean timing: repeat kernels inside one jit (fori_loop) + forced host
+transfer, so async-dispatch / tunnel round-trip artifacts cancel.
+Measures: axis1 lane gather (8192,128), axis0 (8,128) sublane gather,
+XLA gather at 50M, and a prototype windowed-gather kernel block."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+rng = np.random.default_rng(0)
+R, L = 8192, 128
+REPS = 40
+
+
+def timeit(name, jitted, *args, reps=3):
+    r = np.asarray(jax.tree.leaves(jitted(*args))[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = np.asarray(jax.tree.leaves(jitted(*args))[0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name}: {dt*1e3:.2f} ms total, {dt/REPS*1e3:.3f} ms/call", flush=True)
+
+
+# 1. axis1 lane gather chained 40x
+t2d = jax.device_put(jnp.asarray(rng.random((R, L), dtype=np.float32)))
+idx1 = jax.device_put(jnp.asarray(rng.integers(0, L, (R, L)).astype(np.int32)))
+
+g1 = pl.pallas_call(
+    lambda t_ref, i_ref, o_ref: o_ref.__setitem__(
+        slice(None), jnp.take_along_axis(t_ref[:], i_ref[:], axis=1)),
+    out_shape=jax.ShapeDtypeStruct((R, L), jnp.float32),
+)
+
+@jax.jit
+def chain1(t, i):
+    return lax.fori_loop(0, REPS, lambda _, x: g1(x, i), t)
+
+timeit("axis1 lane-gather (8192,128) x40 chained", chain1, t2d, idx1)
+
+# 2. windowed-gather prototype: full block (512,128) edges, VMEM table,
+#    in-kernel loop over 64 vregs, 8-way select per vreg.
+BR = 512  # block rows
+wid = rng.integers(0, R // 8, BR // 8).astype(np.int32)  # window per vreg-row
+src_local = rng.integers(0, 1024, (BR, L)).astype(np.int32)  # within-window
+w_np = rng.random((BR, L), dtype=np.float32)
+
+def windowed_kernel(wid_ref, t_ref, s_ref, w_ref, o_ref):
+    out = jnp.zeros((BR, L), jnp.float32)
+    for v in range(BR // 8):
+        win = t_ref[pl.ds(wid_ref[v] * 8, 8), :]          # (8,128) dynamic slice
+        sl = s_ref[pl.ds(v * 8, 8), :]                     # local idx (8,128)
+        sub = sl // 128                                    # sublane in window
+        lane = sl % 128                                    # lane in window
+        acc = jnp.zeros((8, L), jnp.float32)
+        for k in range(8):
+            rowk = jnp.broadcast_to(win[k:k+1, :], (8, L))
+            g = jnp.take_along_axis(rowk, lane, axis=1)
+            acc = jnp.where(sub == k, g, acc)
+        out = out.at[v*8:(v+1)*8, :].set(acc * w_ref[pl.ds(v*8, 8), :])
+    o_ref[:] = out
+
+wk = pl.pallas_call(
+    windowed_kernel,
+    grid=(1,),
+    in_specs=[
+        pl.BlockSpec(memory_space=pl.ANY) if False else pl.BlockSpec((BR // 8,), lambda i: (0,)),
+        pl.BlockSpec((R, L), lambda i: (0, 0)),
+        pl.BlockSpec((BR, L), lambda i: (0, 0)),
+        pl.BlockSpec((BR, L), lambda i: (0, 0)),
+    ],
+    out_specs=pl.BlockSpec((BR, L), lambda i: (0, 0)),
+    out_shape=jax.ShapeDtypeStruct((BR, L), jnp.float32),
+)
+
+wid_d = jax.device_put(jnp.asarray(wid))
+s_d = jax.device_put(jnp.asarray(src_local))
+w_d = jax.device_put(jnp.asarray(w_np))
+
+try:
+    out = np.asarray(jax.jit(wk)(wid_d, t2d, s_d, w_d))
+    tn = np.asarray(t2d)
+    gsrc = wid[np.arange(BR) // 8] * 1024 + src_local.reshape(BR, L)[np.arange(BR)[:, None], np.arange(L)[None, :]]
+    exp = tn.reshape(-1)[wid[np.arange(BR)[:, None] // 8] * 1024 + src_local] * w_np
+    print("windowed kernel correct:", np.allclose(out, exp), flush=True)
+
+    @jax.jit
+    def chainw(wid, t, s, w):
+        def body(_, x):
+            return wk(wid, t, s, x)
+        return lax.fori_loop(0, REPS, body, w)
+    timeit("windowed-gather (512,128) block x40 chained", chainw, wid_d, t2d, s_d, w_d)
+except Exception as e:
+    s = str(e).splitlines()
+    print(f"windowed kernel: FAILED — {type(e).__name__}: {s[0][:200] if s else ''}", flush=True)
+
+# 3. XLA gather 50M chained x4 (too slow for 40)
+E = 50_000_000
+N = R * L
+t_full = jax.device_put(jnp.asarray(rng.random(N, dtype=np.float32)))
+src = jax.device_put(jnp.asarray(rng.integers(0, N, E).astype(np.int32)))
+
+@jax.jit
+def chainx(t, s):
+    return lax.fori_loop(0, 4, lambda _, x: jnp.bincount(jnp.zeros(1, jnp.int32), weights=x[s][:1], length=1)[0] * 0 + x, t)
+
+# simpler: sum of gathers
+@jax.jit
+def chainx2(t, s):
+    def body(_, acc):
+        return acc + t[s].sum()
+    return lax.fori_loop(0, 4, body, jnp.float32(0))
+
+r = float(chainx2(t_full, src)); t0 = time.perf_counter()
+for _ in range(3):
+    r = float(chainx2(t_full, src))
+dt = (time.perf_counter() - t0) / 3
+print(f"XLA gather 50M x4 chained: {dt*1e3:.1f} ms total, {dt/4*1e3:.1f} ms/gather", flush=True)

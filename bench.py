@@ -25,7 +25,7 @@ def main() -> None:
     import numpy as np
 
     from protocol_tpu.models.graphs import scale_free
-    from protocol_tpu.ops.sparse import converge_sparse
+    from protocol_tpu.ops.sparse import converge_csr
     from protocol_tpu.trust.graph import TrustGraph
 
     n_peers = 1_000_000
@@ -41,7 +41,7 @@ def main() -> None:
 
     device_args = (
         jax.device_put(jnp.asarray(g.src)),
-        jax.device_put(jnp.asarray(g.dst)),
+        jax.device_put(jnp.asarray(g.row_ptr_by_dst())),
         jax.device_put(jnp.asarray(g.weight)),
         jax.device_put(jnp.asarray(p)),
         jax.device_put(jnp.asarray(p)),
@@ -50,8 +50,8 @@ def main() -> None:
     jax.block_until_ready(device_args)
 
     def run():
-        t, it, resid = converge_sparse(
-            *device_args, n=g.n, alpha=jnp.float32(0.1), tol=0.0, max_iter=iters
+        t, it, resid = converge_csr(
+            *device_args, alpha=jnp.float32(0.1), tol=0.0, max_iter=iters
         )
         # Force a host transfer: on the tunneled single-chip platform
         # block_until_ready can return before the computation drains, so

@@ -1,0 +1,7 @@
+// Shared constants between zk_runtime.cpp and zk_ifma.cpp.
+#pragma once
+
+// Maximum stack depth of the gate-program interpreter.  The validator
+// admits programs up to this bound, so every interpreter (scalar and
+// IFMA) must allocate exactly this many slots.
+#define ZK_EVAL_STACK_DEPTH 160

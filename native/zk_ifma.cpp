@@ -1,0 +1,787 @@
+// 8-way AVX-512IFMA radix-52 Montgomery engine for Bn254 Fr.
+//
+// The reference's prover inherits halo2's tuned Rust field backend
+// (circuit/src/utils.rs:259-281 create_proof); this is the rebuild's
+// host-side analog: the batch-parallel proving loops (NTT butterflies,
+// gate-program evaluation over the extended coset, vector mul /
+// scale-add) run eight field elements per instruction via
+// vpmadd52{lu,hu}q.
+//
+// Representation: five 52-bit limbs (radix 2^52, Montgomery R = 2^260),
+// SoA in blocks of eight lanes: block b = five consecutive __m512i,
+// limb l at index 5*b + l.  Values are lazy in [0, 32p) with limbs kept
+// < 2^52 by a carry propagation after every op (vpmadd52 reads only
+// bits 51:0 of its operands).  Bound bookkeeping, in units of p
+// (p ~ 2^254, 2^260 = 64p):
+//   mul:   out < p + in_a*in_b*p/64      (in_a*in_b <= 256 required)
+//   add:   out = a + b
+//   sub<K>: out = a + K                  (requires b < K*p)
+//   normalize (mul by R mod p): out < 1.5p for in < 32p
+// Entry points convert canonical 4x64 limbs in/out with a final exact
+// reduction, so callers never see the lazy domain.
+//
+// Runtime-gated: zk_runtime.cpp dispatches here only when
+// zk_ifma_available() returns 1.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#define ZK_IFMA_BUILD 1
+#endif
+
+#include "constants.h"
+#include "zk_common.h"
+
+extern "C" int64_t zk_ifma_available() {
+#ifdef ZK_IFMA_BUILD
+    __builtin_cpu_init();
+    return __builtin_cpu_supports("avx512f") &&
+           __builtin_cpu_supports("avx512vl") &&
+           __builtin_cpu_supports("avx512dq") &&
+           __builtin_cpu_supports("avx512ifma");
+#else
+    return 0;
+#endif
+}
+
+#ifdef ZK_IFMA_BUILD
+
+namespace {
+
+constexpr uint64_t MASK52 = (1ULL << 52) - 1;
+typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------------
+// Scalar radix-52 arithmetic (setup: constants, twiddle tables).
+
+struct N52 {
+    uint64_t l[5];
+};
+
+inline N52 to52(const uint64_t a[4]) {
+    N52 r;
+    r.l[0] = a[0] & MASK52;
+    r.l[1] = ((a[0] >> 52) | (a[1] << 12)) & MASK52;
+    r.l[2] = ((a[1] >> 40) | (a[2] << 24)) & MASK52;
+    r.l[3] = ((a[2] >> 28) | (a[3] << 36)) & MASK52;
+    r.l[4] = a[3] >> 16;
+    return r;
+}
+
+inline void from52(uint64_t out[4], const N52 &a) {
+    out[0] = a.l[0] | (a.l[1] << 52);
+    out[1] = (a.l[1] >> 12) | (a.l[2] << 40);
+    out[2] = (a.l[2] >> 24) | (a.l[3] << 28);
+    out[3] = (a.l[3] >> 36) | (a.l[4] << 16);
+}
+
+inline int cmp256(const uint64_t a[4], const uint64_t b[4]) {
+    for (int i = 3; i >= 0; --i) {
+        if (a[i] != b[i]) return a[i] < b[i] ? -1 : 1;
+    }
+    return 0;
+}
+
+inline void sub256(uint64_t a[4], const uint64_t b[4]) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; ++i) {
+        u128 d = (u128)a[i] - b[i] - borrow;
+        a[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+inline void dbl_mod(uint64_t a[4]) {
+    uint64_t hi = a[3] >> 63;
+    for (int i = 3; i > 0; --i) a[i] = (a[i] << 1) | (a[i - 1] >> 63);
+    a[0] <<= 1;
+    if (hi || cmp256(a, FR_P) >= 0) sub256(a, FR_P);
+}
+
+struct Consts {
+    N52 p;
+    N52 r2;        // 2^520 mod p: canonical -> mont52 factor
+    N52 one_mont;  // 2^260 mod p
+    uint64_t pinv52;
+};
+
+Consts make_consts() {
+    Consts c;
+    c.p = to52(FR_P);
+    uint64_t acc[4] = {1, 0, 0, 0};
+    for (int i = 0; i < 520; ++i) dbl_mod(acc);
+    c.r2 = to52(acc);
+    uint64_t one[4] = {1, 0, 0, 0};
+    for (int i = 0; i < 260; ++i) dbl_mod(one);
+    c.one_mont = to52(one);
+    uint64_t p0 = FR_P[0];
+    uint64_t inv = 1;
+    for (int i = 0; i < 6; ++i) inv *= 2 - p0 * inv;
+    c.pinv52 = (0 - inv) & MASK52;
+    return c;
+}
+
+const Consts &CC() {
+    static const Consts c = make_consts();
+    return c;
+}
+
+// Scalar Montgomery-52 product with full reduction to [0, p).
+N52 s52_mul(const N52 &a, const N52 &b) {
+    const Consts &c = CC();
+    u128 t[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 5; ++i) {
+        for (int j = 0; j < 5; ++j) {
+            u128 prod = (u128)a.l[i] * b.l[j];
+            t[j] += prod & MASK52;
+            t[j + 1] += (uint64_t)(prod >> 52);
+        }
+        uint64_t y = ((uint64_t)t[0] * c.pinv52) & MASK52;
+        for (int j = 0; j < 5; ++j) {
+            u128 prod = (u128)y * c.p.l[j];
+            t[j] += prod & MASK52;
+            t[j + 1] += (uint64_t)(prod >> 52);
+        }
+        t[0] >>= 52;
+        t[0] += t[1];
+        for (int j = 1; j < 5; ++j) t[j] = t[j + 1];
+        t[5] = 0;
+    }
+    N52 r;
+    u128 carry = 0;
+    for (int i = 0; i < 5; ++i) {
+        u128 v = t[i] + carry;
+        r.l[i] = (uint64_t)v & MASK52;
+        carry = v >> 52;
+    }
+    // Exact reduce (value < 2p here).
+    uint64_t c4[4];
+    from52(c4, r);
+    if (cmp256(c4, FR_P) >= 0) {
+        sub256(c4, FR_P);
+        return to52(c4);
+    }
+    return r;
+}
+
+// canonical -> Montgomery-52, fully reduced.
+inline N52 s52_to_mont(const uint64_t a[4]) { return s52_mul(to52(a), CC().r2); }
+
+inline N52 s52_from_mont_n52(const N52 &a) {
+    N52 one = {{1, 0, 0, 0, 0}};
+    return s52_mul(a, one);
+}
+
+// ---------------------------------------------------------------------
+// Vector core.
+
+#define ZK_TGT __attribute__((target("avx512f,avx512vl,avx512dq,avx512ifma")))
+
+struct V8 {
+    __m512i l[5];
+};
+
+ZK_TGT inline V8 v8_zero() {
+    V8 r;
+    for (int i = 0; i < 5; ++i) r.l[i] = _mm512_setzero_si512();
+    return r;
+}
+
+ZK_TGT inline V8 v8_bcast(const N52 &a) {
+    V8 r;
+    for (int i = 0; i < 5; ++i) r.l[i] = _mm512_set1_epi64((long long)a.l[i]);
+    return r;
+}
+
+// Unsigned carry propagation: limbs -> [0, 2^52), value unchanged.
+ZK_TGT inline void v8_carry(V8 &a) {
+    const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+    for (int i = 0; i < 4; ++i) {
+        __m512i c = _mm512_srli_epi64(a.l[i], 52);
+        a.l[i] = _mm512_and_si512(a.l[i], mask);
+        a.l[i + 1] = _mm512_add_epi64(a.l[i + 1], c);
+    }
+}
+
+// Signed carry propagation (for subtraction; borrows ride as negative
+// carries, the total value is non-negative by the caller's invariant).
+ZK_TGT inline void v8_carry_signed(V8 &a) {
+    const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+    for (int i = 0; i < 4; ++i) {
+        __m512i c = _mm512_srai_epi64(a.l[i], 52);
+        a.l[i] = _mm512_and_si512(a.l[i], mask);
+        a.l[i + 1] = _mm512_add_epi64(a.l[i + 1], c);
+    }
+}
+
+// Montgomery product a*b/2^260; out < p + (a/p)*(b/p)*p/64.
+ZK_TGT inline V8 v8_mul(const V8 &a, const V8 &b) {
+    const Consts &c = CC();
+    const __m512i zero = _mm512_setzero_si512();
+    __m512i p0 = _mm512_set1_epi64((long long)c.p.l[0]);
+    __m512i p1 = _mm512_set1_epi64((long long)c.p.l[1]);
+    __m512i p2 = _mm512_set1_epi64((long long)c.p.l[2]);
+    __m512i p3 = _mm512_set1_epi64((long long)c.p.l[3]);
+    __m512i p4 = _mm512_set1_epi64((long long)c.p.l[4]);
+    __m512i pinv = _mm512_set1_epi64((long long)c.pinv52);
+    __m512i t0 = zero, t1 = zero, t2 = zero, t3 = zero, t4 = zero, t5 = zero;
+    for (int i = 0; i < 5; ++i) {
+        __m512i ai = a.l[i];
+        t0 = _mm512_madd52lo_epu64(t0, ai, b.l[0]);
+        t1 = _mm512_madd52lo_epu64(t1, ai, b.l[1]);
+        t2 = _mm512_madd52lo_epu64(t2, ai, b.l[2]);
+        t3 = _mm512_madd52lo_epu64(t3, ai, b.l[3]);
+        t4 = _mm512_madd52lo_epu64(t4, ai, b.l[4]);
+        t1 = _mm512_madd52hi_epu64(t1, ai, b.l[0]);
+        t2 = _mm512_madd52hi_epu64(t2, ai, b.l[1]);
+        t3 = _mm512_madd52hi_epu64(t3, ai, b.l[2]);
+        t4 = _mm512_madd52hi_epu64(t4, ai, b.l[3]);
+        t5 = _mm512_madd52hi_epu64(t5, ai, b.l[4]);
+        __m512i y = _mm512_madd52lo_epu64(zero, t0, pinv);
+        t0 = _mm512_madd52lo_epu64(t0, y, p0);
+        t1 = _mm512_madd52lo_epu64(t1, y, p1);
+        t2 = _mm512_madd52lo_epu64(t2, y, p2);
+        t3 = _mm512_madd52lo_epu64(t3, y, p3);
+        t4 = _mm512_madd52lo_epu64(t4, y, p4);
+        t1 = _mm512_madd52hi_epu64(t1, y, p0);
+        t2 = _mm512_madd52hi_epu64(t2, y, p1);
+        t3 = _mm512_madd52hi_epu64(t3, y, p2);
+        t4 = _mm512_madd52hi_epu64(t4, y, p3);
+        t5 = _mm512_madd52hi_epu64(t5, y, p4);
+        __m512i carry = _mm512_srli_epi64(t0, 52);
+        t0 = _mm512_add_epi64(t1, carry);
+        t1 = t2;
+        t2 = t3;
+        t3 = t4;
+        t4 = t5;
+        t5 = zero;
+    }
+    V8 r;
+    r.l[0] = t0;
+    r.l[1] = t1;
+    r.l[2] = t2;
+    r.l[3] = t3;
+    r.l[4] = t4;
+    v8_carry(r);
+    return r;
+}
+
+ZK_TGT inline V8 v8_add(const V8 &a, const V8 &b) {
+    V8 r;
+    for (int i = 0; i < 5; ++i) r.l[i] = _mm512_add_epi64(a.l[i], b.l[i]);
+    v8_carry(r);
+    return r;
+}
+
+// a - b + K*p; requires b < K*p.
+template <int K>
+ZK_TGT inline V8 v8_sub(const V8 &a, const V8 &b) {
+    const Consts &c = CC();
+    V8 r;
+    for (int i = 0; i < 5; ++i) {
+        __m512i kp = _mm512_set1_epi64((long long)(c.p.l[i] * (uint64_t)K));
+        r.l[i] = _mm512_sub_epi64(_mm512_add_epi64(a.l[i], kp), b.l[i]);
+    }
+    // K*p per-limb products stay < 2^57 for K <= 16; signed carries fix
+    // both the scaled-limb overflow and subtraction borrows.
+    v8_carry_signed(r);
+    return r;
+}
+
+// Reduce the lazy bound: x -> x mod p + <1.5p, staying in the
+// Montgomery domain (multiply by R mod p).
+ZK_TGT inline V8 v8_normalize(const V8 &a) { return v8_mul(a, v8_bcast(CC().one_mont)); }
+
+// Exact canonical value: leave Montgomery domain, then one conditional
+// subtract (input < 32p).
+ZK_TGT inline V8 v8_to_std_reduced(const V8 &a) {
+    N52 one = {{1, 0, 0, 0, 0}};
+    V8 y = v8_mul(a, v8_bcast(one));  // < p + 32/64 p < 2p
+    const Consts &c = CC();
+    V8 d;
+    for (int i = 0; i < 5; ++i) {
+        __m512i p = _mm512_set1_epi64((long long)c.p.l[i]);
+        d.l[i] = _mm512_sub_epi64(y.l[i], p);
+    }
+    v8_carry_signed(d);
+    // top limb of d negative => y < p => keep y.
+    __mmask8 neg = _mm512_cmplt_epi64_mask(d.l[4], _mm512_setzero_si512());
+    V8 r;
+    for (int i = 0; i < 5; ++i) r.l[i] = _mm512_mask_blend_epi64(neg, d.l[i], y.l[i]);
+    return r;
+}
+
+// ---------------------------------------------------------------------
+// Canonical (m,4) row-major <-> 52-SoA blocks, 8 rows at a time.
+
+// Transpose 8 rows x 4 u64 -> 4 vectors (one per 64-bit limb).
+ZK_TGT inline void load_tr8(const uint64_t *src, __m512i out[4]) {
+    __m512i z0 = _mm512_loadu_si512(src);
+    __m512i z1 = _mm512_loadu_si512(src + 8);
+    __m512i z2 = _mm512_loadu_si512(src + 16);
+    __m512i z3 = _mm512_loadu_si512(src + 24);
+    const __m512i ia = _mm512_setr_epi64(0, 4, 8, 12, 1, 5, 9, 13);
+    const __m512i ib = _mm512_setr_epi64(2, 6, 10, 14, 3, 7, 11, 15);
+    __m512i u0 = _mm512_permutex2var_epi64(z0, ia, z1);  // r0..r3 limb0 | limb1
+    __m512i u1 = _mm512_permutex2var_epi64(z2, ia, z3);  // r4..r7 limb0 | limb1
+    __m512i v0 = _mm512_permutex2var_epi64(z0, ib, z1);  // r0..r3 limb2 | limb3
+    __m512i v1 = _mm512_permutex2var_epi64(z2, ib, z3);
+    const __m512i lo = _mm512_setr_epi64(0, 1, 2, 3, 8, 9, 10, 11);
+    const __m512i hi = _mm512_setr_epi64(4, 5, 6, 7, 12, 13, 14, 15);
+    out[0] = _mm512_permutex2var_epi64(u0, lo, u1);
+    out[1] = _mm512_permutex2var_epi64(u0, hi, u1);
+    out[2] = _mm512_permutex2var_epi64(v0, lo, v1);
+    out[3] = _mm512_permutex2var_epi64(v0, hi, v1);
+}
+
+ZK_TGT inline void store_tr8(uint64_t *dst, const __m512i in[4]) {
+    const __m512i lo = _mm512_setr_epi64(0, 1, 2, 3, 8, 9, 10, 11);
+    const __m512i hi = _mm512_setr_epi64(4, 5, 6, 7, 12, 13, 14, 15);
+    __m512i u0 = _mm512_permutex2var_epi64(in[0], lo, in[1]);  // r0..r3 l0|l1
+    __m512i u1 = _mm512_permutex2var_epi64(in[0], hi, in[1]);  // r4..r7 l0|l1
+    __m512i v0 = _mm512_permutex2var_epi64(in[2], lo, in[3]);
+    __m512i v1 = _mm512_permutex2var_epi64(in[2], hi, in[3]);
+    const __m512i ia = _mm512_setr_epi64(0, 4, 8, 12, 1, 5, 9, 13);
+    const __m512i ib = _mm512_setr_epi64(2, 6, 10, 14, 3, 7, 11, 15);
+    _mm512_storeu_si512(dst, _mm512_permutex2var_epi64(u0, ia, v0));
+    _mm512_storeu_si512(dst + 8, _mm512_permutex2var_epi64(u0, ib, v0));
+    _mm512_storeu_si512(dst + 16, _mm512_permutex2var_epi64(u1, ia, v1));
+    _mm512_storeu_si512(dst + 24, _mm512_permutex2var_epi64(u1, ib, v1));
+}
+
+// 4x64 vectors -> 5x52 vectors.
+ZK_TGT inline V8 radix52(const __m512i L[4]) {
+    const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+    V8 r;
+    r.l[0] = _mm512_and_si512(L[0], mask);
+    r.l[1] = _mm512_and_si512(
+        _mm512_or_si512(_mm512_srli_epi64(L[0], 52), _mm512_slli_epi64(L[1], 12)),
+        mask);
+    r.l[2] = _mm512_and_si512(
+        _mm512_or_si512(_mm512_srli_epi64(L[1], 40), _mm512_slli_epi64(L[2], 24)),
+        mask);
+    r.l[3] = _mm512_and_si512(
+        _mm512_or_si512(_mm512_srli_epi64(L[2], 28), _mm512_slli_epi64(L[3], 36)),
+        mask);
+    r.l[4] = _mm512_srli_epi64(L[3], 16);
+    return r;
+}
+
+ZK_TGT inline void radix64(const V8 &a, __m512i L[4]) {
+    L[0] = _mm512_or_si512(a.l[0], _mm512_slli_epi64(a.l[1], 52));
+    L[1] = _mm512_or_si512(_mm512_srli_epi64(a.l[1], 12), _mm512_slli_epi64(a.l[2], 40));
+    L[2] = _mm512_or_si512(_mm512_srli_epi64(a.l[2], 24), _mm512_slli_epi64(a.l[3], 28));
+    L[3] = _mm512_or_si512(_mm512_srli_epi64(a.l[3], 36), _mm512_slli_epi64(a.l[4], 16));
+}
+
+// Load 8 canonical rows -> Montgomery-52 (bound < 1.5p).
+ZK_TGT inline V8 v8_load_mont(const uint64_t *src, const V8 &r2v) {
+    __m512i L[4];
+    load_tr8(src, L);
+    return v8_mul(radix52(L), r2v);
+}
+
+// Store 8 lazy values -> canonical rows.
+ZK_TGT inline void v8_store_std(uint64_t *dst, const V8 &a) {
+    __m512i L[4];
+    radix64(v8_to_std_reduced(a), L);
+    store_tr8(dst, L);
+}
+
+// Store a *standard-domain* value < 2p: one conditional subtract, no
+// Montgomery conversion.
+ZK_TGT inline void v8_store_plain2p(uint64_t *dst, const V8 &a) {
+    const Consts &c = CC();
+    V8 d;
+    for (int i = 0; i < 5; ++i) {
+        __m512i p = _mm512_set1_epi64((long long)c.p.l[i]);
+        d.l[i] = _mm512_sub_epi64(a.l[i], p);
+    }
+    v8_carry_signed(d);
+    __mmask8 neg = _mm512_cmplt_epi64_mask(d.l[4], _mm512_setzero_si512());
+    V8 r;
+    for (int i = 0; i < 5; ++i) r.l[i] = _mm512_mask_blend_epi64(neg, d.l[i], a.l[i]);
+    __m512i L[4];
+    radix64(r, L);
+    store_tr8(dst, L);
+}
+
+// ---------------------------------------------------------------------
+// NTT.
+
+struct StageTables {
+    // Per-stage twiddles for len >= 16 stages, 52-SoA, exactly reduced:
+    // stage s holds half(s) entries (half = len/2, len = 16 << s).
+    std::vector<std::vector<V8>> big;
+    // Lane-constant twiddle vectors for len = 2, 4, 8.
+    V8 tw2, tw4, tw8;
+    V8 ninv_mont;  // n^-1 in Montgomery-52 (inverse transforms)
+};
+
+ZK_TGT V8 pack_lanes(const N52 v[8]) {
+    V8 r;
+    alignas(64) uint64_t buf[8];
+    for (int limb = 0; limb < 5; ++limb) {
+        for (int l = 0; l < 8; ++l) buf[l] = v[l].l[limb];
+        r.l[limb] = _mm512_load_si512(buf);
+    }
+    return r;
+}
+
+ZK_TGT StageTables make_tables(int64_t n, const uint64_t *root_canon) {
+    StageTables st;
+    N52 root = s52_to_mont(root_canon);
+    // tw[i] = root^i for i < n/2 (Montgomery-52, exact).
+    std::vector<N52> tw(n / 2);
+    N52 one = CC().one_mont;
+    tw[0] = one;
+    for (int64_t i = 1; i < n / 2; ++i) tw[i] = s52_mul(tw[i - 1], root);
+
+    // Small stages: len=2 twiddle is 1; len=4 lanes use j in {0,1} with
+    // step n/4; len=8 lanes j in {0..3} with step n/8.
+    N52 lanes2[8], lanes4[8], lanes8[8];
+    for (int l = 0; l < 8; ++l) {
+        lanes2[l] = tw[0];
+        int j4 = l & 1;  // within len=4 group: lanes {0,1}=low, {2,3}=high; j = l & 1
+        lanes4[l] = tw[(int64_t)j4 * (n / 4)];
+        int j8 = l & 3;
+        lanes8[l] = tw[(int64_t)j8 * (n / 8)];
+    }
+    st.tw2 = pack_lanes(lanes2);
+    st.tw4 = pack_lanes(lanes4);
+    st.tw8 = pack_lanes(lanes8);
+
+    for (int64_t len = 16; len <= n; len <<= 1) {
+        int64_t half = len >> 1, step = n / len;
+        std::vector<V8> stage(half / 8);
+        alignas(64) uint64_t buf[8];
+        for (int64_t j0 = 0; j0 < half; j0 += 8) {
+            V8 v;
+            for (int limb = 0; limb < 5; ++limb) {
+                for (int l = 0; l < 8; ++l) buf[l] = tw[(j0 + l) * step].l[limb];
+                v.l[limb] = _mm512_load_si512(buf);
+            }
+            stage[j0 / 8] = v;
+        }
+        st.big.push_back(std::move(stage));
+    }
+
+    // n^-1 mod p in Montgomery-52: (n in mont)^(p-2) is overkill — use
+    // Fermat via square-and-multiply on the scalar path.
+    {
+        uint64_t n4[4] = {(uint64_t)n, 0, 0, 0};
+        N52 nm = s52_to_mont(n4);
+        // exponent p-2
+        uint64_t e[4];
+        memcpy(e, FR_P, 32);
+        // subtract 2
+        uint64_t two[4] = {2, 0, 0, 0};
+        sub256(e, two);
+        N52 acc = one;
+        for (int bit = 253; bit >= 0; --bit) {
+            acc = s52_mul(acc, acc);
+            if ((e[bit / 64] >> (bit % 64)) & 1) acc = s52_mul(acc, nm);
+        }
+        st.ninv_mont = v8_bcast(acc);
+    }
+    return st;
+}
+
+struct TableKey {
+    int64_t n;
+    uint64_t r0, r1, r2, r3;
+    bool operator<(const TableKey &o) const {
+        if (n != o.n) return n < o.n;
+        if (r0 != o.r0) return r0 < o.r0;
+        if (r1 != o.r1) return r1 < o.r1;
+        if (r2 != o.r2) return r2 < o.r2;
+        return r3 < o.r3;
+    }
+};
+
+ZK_TGT const StageTables &tables_for(int64_t n, const uint64_t *root) {
+    // ctypes releases the GIL, so concurrent zk_ntt calls can race on
+    // this cache — serialize the lookup (table build is one-time).
+    static std::mutex mu;
+    static std::map<TableKey, StageTables> cache;
+    std::lock_guard<std::mutex> lock(mu);
+    TableKey k{n, root[0], root[1], root[2], root[3]};
+    auto it = cache.find(k);
+    if (it == cache.end()) it = cache.emplace(k, make_tables(n, root)).first;
+    return it->second;
+}
+
+inline int64_t bitrev(int64_t x, int bits) {
+    int64_t r = 0;
+    for (int i = 0; i < bits; ++i) {
+        r = (r << 1) | (x & 1);
+        x >>= 1;
+    }
+    return r;
+}
+
+// Butterfly on whole blocks: (A, B) -> (A + tw*B, A - tw*B).
+ZK_TGT inline void bfly(V8 &a, V8 &b, const V8 &tw) {
+    V8 t = v8_mul(tw, b);  // tw exact (<p), b < 16p  =>  t < p + 16p/64 < 2p
+    V8 anew = v8_add(a, t);
+    b = v8_sub<2>(a, t);
+    a = anew;
+}
+
+// Small-stage butterfly inside one block: partner lane = lane ^ half.
+template <int HALF>
+ZK_TGT inline V8 bfly_small(const V8 &x, const V8 &tw) {
+    constexpr __mmask8 HI = (HALF == 1) ? 0xAA : (HALF == 2) ? 0xCC : 0xF0;
+    V8 xp, z, w, t, r;
+    __m512i idx;
+    if (HALF == 1)
+        idx = _mm512_setr_epi64(1, 0, 3, 2, 5, 4, 7, 6);
+    else if (HALF == 2)
+        idx = _mm512_setr_epi64(2, 3, 0, 1, 6, 7, 4, 5);
+    else
+        idx = _mm512_setr_epi64(4, 5, 6, 7, 0, 1, 2, 3);
+    for (int i = 0; i < 5; ++i) xp.l[i] = _mm512_permutexvar_epi64(idx, x.l[i]);
+    // z: the high-half operand aligned to every lane; w: the low-half.
+    for (int i = 0; i < 5; ++i) {
+        z.l[i] = _mm512_mask_blend_epi64(HI, xp.l[i], x.l[i]);
+        w.l[i] = _mm512_mask_blend_epi64(HI, x.l[i], xp.l[i]);
+    }
+    t = v8_mul(tw, z);
+    V8 sum = v8_add(w, t);
+    V8 diff = v8_sub<2>(w, t);
+    for (int i = 0; i < 5; ++i) r.l[i] = _mm512_mask_blend_epi64(HI, sum.l[i], diff.l[i]);
+    return r;
+}
+
+ZK_TGT void ifma_ntt_impl(uint64_t *data, int64_t n, const uint64_t *root_canon,
+                          int inverse) {
+    const StageTables &st = tables_for(n, root_canon);
+    int bits = 0;
+    while ((1LL << bits) < n) ++bits;
+
+    int64_t nb = n / 8;
+    std::vector<V8> buf(nb);
+    V8 r2v = v8_bcast(CC().r2);
+    // Pack with bit-reversed row reads; convert to Montgomery-52.
+    {
+        alignas(64) uint64_t rows[32];
+        for (int64_t b = 0; b < nb; ++b) {
+            for (int l = 0; l < 8; ++l) {
+                int64_t src = bitrev(8 * b + l, bits);
+                memcpy(rows + 4 * l, data + 4 * src, 32);
+            }
+            __m512i L[4];
+            load_tr8(rows, L);
+            buf[b] = v8_mul(radix52(L), r2v);  // < 1.5p
+        }
+    }
+
+    // Small stages (in-block).  Bounds: start < 1.5p; each stage adds
+    // at most max(t, 2p) => < 2p growth per stage.
+    if (n >= 2) {
+        for (int64_t b = 0; b < nb; ++b) buf[b] = bfly_small<1>(buf[b], st.tw2);
+    }
+    if (n >= 4) {
+        for (int64_t b = 0; b < nb; ++b) buf[b] = bfly_small<2>(buf[b], st.tw4);
+    }
+    if (n >= 8) {
+        for (int64_t b = 0; b < nb; ++b) buf[b] = bfly_small<4>(buf[b], st.tw8);
+    }
+
+    // Big stages.  Normalize the whole array every 6 stages to keep the
+    // lazy bound under 16p (growth <= 2p per stage from ~2p base).
+    int since_norm = 0;
+    int sidx = 0;
+    for (int64_t len = 16; len <= n; len <<= 1, ++sidx) {
+        int64_t half = len >> 1;
+        const std::vector<V8> &tws = st.big[sidx];
+        for (int64_t start = 0; start < n; start += len) {
+            for (int64_t j = 0; j < half; j += 8) {
+                int64_t ia = (start + j) / 8, ib = (start + j + half) / 8;
+                bfly(buf[ia], buf[ib], tws[j / 8]);
+            }
+        }
+        if (++since_norm == 6 && len < n) {
+            for (int64_t b = 0; b < nb; ++b) buf[b] = v8_normalize(buf[b]);
+            since_norm = 0;
+        }
+    }
+
+    if (inverse) {
+        for (int64_t b = 0; b < nb; ++b) buf[b] = v8_mul(buf[b], st.ninv_mont);
+    }
+
+    for (int64_t b = 0; b < nb; ++b) v8_store_std(data + 32 * b, buf[b]);
+}
+
+// ---------------------------------------------------------------------
+// Gate-program evaluation (stack machine, 8 points per step).
+//
+// Columns arrive as canonical (m,4) arrays; rotations index blocks
+// directly because rot*rot_stride is a multiple of 8 (checked by the
+// dispatcher).  Bounds are tracked per stack slot in units of p and
+// operands normalized when a multiply would exceed the lazy window.
+
+ZK_TGT int64_t ifma_eval_impl(int64_t m, int64_t n_cols,
+                              const uint64_t *const *cols, int64_t rot_stride,
+                              const int64_t *code, int64_t code_len,
+                              const uint64_t *consts, int64_t n_consts,
+                              uint64_t *out) {
+    const int STACK = ZK_EVAL_STACK_DEPTH;
+    int64_t mb = m / 8;
+    // Pre-convert columns to Montgomery-52 SoA.
+    std::vector<std::vector<V8>> mcols(n_cols);
+    V8 r2v = v8_bcast(CC().r2);
+    for (int64_t ci = 0; ci < n_cols; ++ci) {
+        mcols[ci].resize(mb);
+        const uint64_t *src = cols[ci];
+        for (int64_t b = 0; b < mb; ++b) mcols[ci][b] = v8_load_mont(src + 32 * b, r2v);
+    }
+    std::vector<V8> cmont(n_consts ? n_consts : 1);
+    for (int64_t i = 0; i < n_consts; ++i) cmont[i] = v8_bcast(s52_to_mont(consts + 4 * i));
+
+#pragma omp parallel
+    {
+    std::vector<V8> stack(STACK);
+    std::vector<int> bound(STACK);
+#pragma omp for schedule(static)
+    for (int64_t b = 0; b < mb; ++b) {
+        int sp = 0;
+        for (int64_t pc = 0; pc < code_len;) {
+            int64_t op = code[pc++];
+            switch (op) {
+            case 0: {
+                int64_t col = code[pc++];
+                int64_t rot = code[pc++];
+                int64_t blk = (b + rot * rot_stride / 8) % mb;
+                if (blk < 0) blk += mb;
+                stack[sp] = mcols[col][blk];
+                bound[sp++] = 2;
+                break;
+            }
+            case 1:
+                stack[sp] = cmont[code[pc++]];
+                bound[sp++] = 1;
+                break;
+            case 2:
+                --sp;
+                if (bound[sp - 1] + bound[sp] > 30) {
+                    stack[sp - 1] = v8_normalize(stack[sp - 1]);
+                    bound[sp - 1] = 2;
+                    if (bound[sp] > 15) {
+                        stack[sp] = v8_normalize(stack[sp]);
+                        bound[sp] = 2;
+                    }
+                }
+                stack[sp - 1] = v8_add(stack[sp - 1], stack[sp]);
+                bound[sp - 1] += bound[sp];
+                break;
+            case 3:
+                --sp;
+                if (bound[sp] > 8) {
+                    stack[sp] = v8_normalize(stack[sp]);
+                    bound[sp] = 2;
+                }
+                if (bound[sp - 1] > 22) {
+                    stack[sp - 1] = v8_normalize(stack[sp - 1]);
+                    bound[sp - 1] = 2;
+                }
+                stack[sp - 1] = v8_sub<8>(stack[sp - 1], stack[sp]);
+                bound[sp - 1] += 8;
+                break;
+            case 4:
+                --sp;
+                if (bound[sp - 1] > 16) {
+                    stack[sp - 1] = v8_normalize(stack[sp - 1]);
+                    bound[sp - 1] = 2;
+                }
+                if (bound[sp] > 16) {
+                    stack[sp] = v8_normalize(stack[sp]);
+                    bound[sp] = 2;
+                }
+                stack[sp - 1] = v8_mul(stack[sp - 1], stack[sp]);
+                // out < p + ba*bb*p/64, ba*bb <= 256 => < 5p
+                bound[sp - 1] = 1 + (bound[sp - 1] * bound[sp] + 63) / 64;
+                break;
+            case 5:
+                if (bound[sp - 1] > 8) {
+                    stack[sp - 1] = v8_normalize(stack[sp - 1]);
+                    bound[sp - 1] = 2;
+                }
+                stack[sp - 1] = v8_sub<8>(v8_zero(), stack[sp - 1]);
+                bound[sp - 1] = 8;
+                break;
+            }
+        }
+        v8_store_std(out + 32 * b, stack[0]);
+    }
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------------
+// Vector mul / scale-add.
+
+ZK_TGT void ifma_vec_mul_impl(const uint64_t *a, const uint64_t *b, uint64_t *out,
+                              int64_t n) {
+    V8 r2v = v8_bcast(CC().r2);
+#pragma omp parallel for schedule(static) if (n >= 65536)
+    for (int64_t blk = 0; blk < n / 8; ++blk) {
+        V8 x = v8_load_mont(a + 32 * blk, r2v);
+        V8 y = v8_load_mont(b + 32 * blk, r2v);
+        v8_store_std(out + 32 * blk, v8_mul(x, y));
+    }
+}
+
+ZK_TGT void ifma_scale_add_impl(uint64_t *acc, const uint64_t *p, const uint64_t *s,
+                                int64_t n) {
+    V8 r2v = v8_bcast(CC().r2);
+    V8 sv = v8_bcast(s52_to_mont(s));
+    for (int64_t blk = 0; blk < n / 8; ++blk) {
+        V8 x = v8_load_mont(p + 32 * blk, r2v);
+        V8 prod = v8_mul(x, sv);  // < p + 1.5*1*p/64 < 2p
+        __m512i L[4];
+        load_tr8(acc + 32 * blk, L);
+        V8 a = radix52(L);  // canonical, < p: plain (non-Montgomery) value
+        // prod is Montgomery; convert to std (exact, < p) before the
+        // canonical add, then store with a plain reduction — the sum is
+        // standard-domain, so no Montgomery factor must be applied.
+        V8 pstd = v8_to_std_reduced(prod);
+        v8_store_plain2p(acc + 32 * blk, v8_add(a, pstd));
+    }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------
+// C entry points (called from zk_runtime.cpp dispatchers).
+
+extern "C" {
+
+void ifma_ntt(uint64_t *data, int64_t n, const uint64_t *root_canon, int inverse) {
+    ifma_ntt_impl(data, n, root_canon, inverse);
+}
+
+int64_t ifma_eval_program(int64_t m, int64_t n_cols, const uint64_t *const *cols,
+                          int64_t rot_stride, const int64_t *code, int64_t code_len,
+                          const uint64_t *consts, int64_t n_consts, uint64_t *out) {
+    return ifma_eval_impl(m, n_cols, cols, rot_stride, code, code_len, consts,
+                          n_consts, out);
+}
+
+void ifma_vec_mul(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) {
+    ifma_vec_mul_impl(a, b, out, n);
+}
+
+void ifma_scale_add(uint64_t *acc, const uint64_t *p, const uint64_t *s, int64_t n) {
+    ifma_scale_add_impl(acc, p, s, n);
+}
+
+}  // extern "C"
+
+#endif  // ZK_IFMA_BUILD

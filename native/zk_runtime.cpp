@@ -15,6 +15,7 @@
 // Build: make -C native libzk_runtime.so
 
 #include "constants.h"
+#include "zk_common.h"
 
 #include <cstdint>
 #include <cstring>
@@ -204,12 +205,36 @@ static void bit_reverse_permute(FrF *data, int64_t n) {
 
 extern "C" {
 
-int64_t zk_abi_version() { return 2; }
+int64_t zk_abi_version() { return 3; }
+
+// AVX-512IFMA engine (zk_ifma.cpp), dispatched at runtime.
+extern "C" {
+int64_t zk_ifma_available();
+#if defined(__x86_64__)
+void ifma_ntt(uint64_t *data, int64_t n, const uint64_t *root_canon, int inverse);
+int64_t ifma_eval_program(int64_t m, int64_t n_cols, const uint64_t *const *cols,
+                          int64_t rot_stride, const int64_t *code, int64_t code_len,
+                          const uint64_t *consts, int64_t n_consts, uint64_t *out);
+void ifma_vec_mul(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n);
+void ifma_scale_add(uint64_t *acc, const uint64_t *p, const uint64_t *s, int64_t n);
+#endif
+}
+
+static inline bool use_ifma() {
+    static const bool ok = zk_ifma_available() != 0;
+    return ok;
+}
 
 // In-place NTT of `data` (n x 4 canonical limbs).  `root_canon` must be
 // a primitive n-th root of unity (pass the inverse root for the inverse
 // transform; inverse=1 additionally scales by n^-1).
 void zk_ntt(uint64_t *data, int64_t n, const uint64_t *root_canon, int inverse) {
+#if defined(__x86_64__)
+    if (use_ifma() && n >= 16) {
+        ifma_ntt(data, n, root_canon, inverse);
+        return;
+    }
+#endif
     std::vector<FrF> buf(n);
     for (int64_t i = 0; i < n; ++i) FrF::to_mont(buf[i], data + 4 * i);
 
@@ -254,6 +279,17 @@ void zk_ntt(uint64_t *data, int64_t n, const uint64_t *root_canon, int inverse) 
 }
 
 void zk_vec_mul(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) {
+#if defined(__x86_64__)
+    if (use_ifma() && n >= 8) {
+        int64_t head = n & ~7LL;
+        ifma_vec_mul(a, b, out, head);
+        a += 4 * head;
+        b += 4 * head;
+        out += 4 * head;
+        n -= head;
+        if (!n) return;
+    }
+#endif
 #pragma omp parallel for schedule(static) if (n >= 4096)
     for (int64_t i = 0; i < n; ++i) {
         FrF x, y, z;
@@ -279,6 +315,16 @@ void zk_powers(const uint64_t *base_canon, int64_t n, uint64_t *out) {
 // acc/p are canonical; the product is computed in Montgomery form and
 // converted back before the canonical add.
 void zk_scale_add(uint64_t *acc, const uint64_t *p, const uint64_t *s_canon, int64_t n) {
+#if defined(__x86_64__)
+    if (use_ifma() && n >= 8) {
+        int64_t head = n & ~7LL;
+        ifma_scale_add(acc, p, s_canon, head);
+        acc += 4 * head;
+        p += 4 * head;
+        n -= head;
+        if (!n) return;
+    }
+#endif
     FrF s;
     FrF::to_mont(s, s_canon);
     for (int64_t i = 0; i < n; ++i) {
@@ -650,7 +696,7 @@ void zk_srs_powers(const uint64_t *tau, int64_t n, uint64_t *out) {
 //   5           neg
 // Output: m x 4 canonical.
 
-static const int ZK_EVAL_STACK = 160;
+static const int ZK_EVAL_STACK = ZK_EVAL_STACK_DEPTH;
 
 // Pre-pass: simulate stack depth and bounds-check every operand so a
 // malformed program can't overflow the per-thread stack or index out of
@@ -689,11 +735,34 @@ static int zk_validate_program(int64_t n_cols, const int64_t *code,
     return sp;
 }
 
-// Returns 0 on success, -1 if the program is malformed.
+int64_t zk_eval_program2(int64_t m, int64_t n_cols, const uint64_t *const *cols,
+                         int64_t rot_stride, const int64_t *code, int64_t code_len,
+                         const uint64_t *consts, int64_t n_consts, uint64_t *out);
+
+// Stacked-tensor variant kept for ABI continuity: builds the pointer
+// table over the (n_cols, m, 4) tensor and delegates.
 int64_t zk_eval_program(int64_t m, int64_t n_cols, const uint64_t *cols,
                         int64_t rot_stride, const int64_t *code, int64_t code_len,
                         const uint64_t *consts, int64_t n_consts, uint64_t *out) {
+    std::vector<const uint64_t *> ptrs(n_cols);
+    for (int64_t c = 0; c < n_cols; ++c) ptrs[c] = cols + 4 * c * m;
+    return zk_eval_program2(m, n_cols, ptrs.data(), rot_stride, code, code_len,
+                            consts, n_consts, out);
+}
+
+// Pointer-table variant: columns as separate (m,4) arrays (no Python
+// np.stack copy), AVX-512IFMA fast path when rotation offsets stay
+// 8-aligned (rot_stride % 8 == 0, the k>=11 production shape).
+int64_t zk_eval_program2(int64_t m, int64_t n_cols, const uint64_t *const *cols,
+                         int64_t rot_stride, const int64_t *code, int64_t code_len,
+                         const uint64_t *consts, int64_t n_consts, uint64_t *out) {
     if (zk_validate_program(n_cols, code, code_len, n_consts) != 1) return -1;
+#if defined(__x86_64__)
+    if (use_ifma() && m % 8 == 0 && rot_stride % 8 == 0) {
+        return ifma_eval_program(m, n_cols, cols, rot_stride, code, code_len,
+                                 consts, n_consts, out);
+    }
+#endif
     std::vector<FrF> cmont(n_consts);
     for (int64_t i = 0; i < n_consts; ++i) FrF::to_mont(cmont[i], consts + 4 * i);
 
@@ -711,7 +780,7 @@ int64_t zk_eval_program(int64_t m, int64_t n_cols, const uint64_t *cols,
                     int64_t rot = code[pc++];
                     int64_t idx = (i + rot * rot_stride) % m;
                     if (idx < 0) idx += m;
-                    FrF::to_mont(stack[sp++], cols + 4 * (col * m + idx));
+                    FrF::to_mont(stack[sp++], cols[col] + 4 * idx);
                     break;
                 }
                 case 1:

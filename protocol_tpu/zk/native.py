@@ -34,7 +34,7 @@ _I64P = ctypes.POINTER(ctypes.c_int64)
 
 #: Bump together with zk_abi_version() in native/zk_runtime.cpp whenever
 #: symbols are added or signatures change; _load() rebuilds a stale .so.
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 def _rebuild():
@@ -64,13 +64,24 @@ def _load():
     except AttributeError:
         stale = True
     if stale:
-        # A .so from an older checkout: rebuild in place and reload.
+        # A .so from an older checkout: rebuild in place.  dlopen caches
+        # by path — re-opening the same path returns the already-mapped
+        # old object — so load the fresh build through a unique temp
+        # copy to guarantee the new symbols are visible in this process.
         try:
             _rebuild()
         except Exception:
             _lib = False
             raise
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        import shutil
+        import tempfile
+
+        tmp = tempfile.NamedTemporaryFile(
+            prefix="libzk_runtime_", suffix=".so", delete=False
+        )
+        tmp.close()
+        shutil.copy2(_LIB_PATH, tmp.name)
+        lib = ctypes.CDLL(tmp.name)
     lib.zk_ntt.argtypes = [_U64P, ctypes.c_int64, _U64P, ctypes.c_int]
     lib.zk_vec_mul.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
     lib.zk_vec_add.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
@@ -90,6 +101,18 @@ def _load():
         _U64P,
     ]
     lib.zk_eval_program.restype = ctypes.c_int64
+    lib.zk_eval_program2.argtypes = [
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int64,
+        _I64P,
+        ctypes.c_int64,
+        _U64P,
+        ctypes.c_int64,
+        _U64P,
+    ]
+    lib.zk_eval_program2.restype = ctypes.c_int64
     lib.zk_powers.argtypes = [_U64P, ctypes.c_int64, _U64P]
     lib.zk_scale_add.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
     lib.zk_poly_eval.argtypes = [_U64P, ctypes.c_int64, _U64P, _U64P]

@@ -1040,21 +1040,28 @@ class _CosetEvaluator:
                 local[slot] = len(local)
         lib = _native_lib()
         if lib is not None:
+            import ctypes
+
             const_pool: dict[int, int] = {}
             code: list[int] = []
             depth = linearize(sym, local, const_pool, code)
             assert depth <= 150, f"gate program too deep: {depth}"
-            tensor = np.stack([self.array(slot) for slot in local])
+            # Pointer table instead of an np.stack copy: each column is
+            # passed as its own (m,4) C-contiguous array.
+            arrays = [np.ascontiguousarray(self.array(slot)) for slot in local]
+            ptrs = (ctypes.c_void_p * len(arrays))(
+                *[a.ctypes.data for a in arrays]
+            )
             consts = sorted(const_pool, key=const_pool.get)
             out = np.empty((self.m, 4), dtype=np.uint64)
             carr = to_limbs(consts) if consts else np.zeros((1, 4), dtype=np.uint64)
             code_arr = np.asarray(code, dtype=np.int64)
             from .native import _iptr
 
-            rc = lib.zk_eval_program(
+            rc = lib.zk_eval_program2(
                 self.m,
-                len(local),
-                _ptr(np.ascontiguousarray(tensor)),
+                len(arrays),
+                ptrs,
                 self.E,
                 _iptr(code_arr),
                 len(code_arr),
