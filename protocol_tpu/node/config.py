@@ -21,8 +21,9 @@ class ProtocolConfig:
     trust_backend: str = "native-cpu"
     event_fixture: str | None = None
     checkpoint_dir: str | None = None
-    #: "commitment" or "plonk" (real KZG SNARK per epoch).
-    prover: str = "commitment"
+    #: "plonk" (real KZG SNARK per epoch, the reference's behavior) or
+    #: "commitment" (fast Poseidon binding).
+    prover: str = "plonk"
     #: Ceremony SRS file for the PLONK prover (kzg.Setup format).
     srs_path: str | None = None
 

@@ -104,6 +104,7 @@ class PlonkEpochProver(Prover):
         srs=None,
         srs_path: str | None = None,
         k: int | None = None,
+        cache_dir: str | None = None,
     ):
         from ..crypto import calculate_message_hash
         from ..crypto.eddsa import SecretKey, sign
@@ -136,13 +137,7 @@ class PlonkEpochProver(Prover):
         pub = power_iterate([initial_score] * n, rows, num_iter, scale)
         self._dummy_statement = (atts, pub)
         cs = prove_epoch_statement(atts, pub, **self._params)
-        if srs is None and srs_path is not None:
-            from pathlib import Path
-
-            from .kzg import Setup
-
-            srs = Setup.from_bytes(Path(srs_path).read_bytes())
-        if srs is None:
+        if srs is None and srs_path is None:
             # A fresh random setup is fine for development, but its
             # proofs will not verify against anyone else's
             # et_verifier.bin (different vk commitments), and its
@@ -151,11 +146,84 @@ class PlonkEpochProver(Prover):
 
             logging.getLogger(__name__).warning(
                 "PLONK prover booted WITHOUT a ceremony SRS (srs_path unset): "
-                "generating a dev-only random setup. Proofs will only verify "
-                "against artifacts generated from this same setup; do not use "
-                "in production."
+                "generating a dev-only random setup (cached across boots). "
+                "Proofs will only verify against artifacts generated from this "
+                "same setup; do not use in production."
             )
-        self._pk = plonk.compile_circuit(cs, srs=srs, k=k)
+        self._pk = self._compile_cached(cs, srs, srs_path, k, cache_dir)
+
+    def _compile_cached(self, cs, srs, srs_path, k, cache_dir):
+        """Disk-cached keygen: ``compile_circuit`` is deterministic given
+        the circuit structure, SRS, and k, and takes ~13 s at k=14 —
+        the reference pays its minutes-scale Halo2 keygen on every boot
+        (server/src/main.rs:70-83); a node here pays it once per
+        (circuit, SRS, code) triple.  The cache key folds in a hash of
+        every source the compiled key depends on (the zk package, the
+        crypto package it builds circuits over, and the native kernels)
+        so a change to any of them invalidates it.
+
+        Trust boundary: entries are pickles of the proving key — treat
+        the cache directory like a key store (it is created 0700; a
+        writer there can already substitute your proving key)."""
+        import hashlib
+        import json as _json
+        import os
+        import pickle
+        import uuid
+        from pathlib import Path
+
+        from . import plonk
+
+        root = cache_dir or os.environ.get("PROTOCOL_TPU_CACHE")
+        if root is None:
+            root = Path.home() / ".cache" / "protocol_tpu"
+        root = Path(root)
+
+        h = hashlib.sha256()
+        h.update(_json.dumps(self._params, sort_keys=True).encode())
+        h.update(str(k).encode())
+        if srs_path is not None and srs is None:
+            h.update(b"srs-file")
+            h.update(hashlib.sha256(Path(srs_path).read_bytes()).digest())
+        elif srs is not None:
+            # Setup objects are identified by size + a probe point (the
+            # full g1 ladder is MBs; tau binds every power).
+            h.update(f"srs-obj-{srs.k}-{srs.g1_powers[1]}-{srs.tau_g2}".encode())
+        else:
+            h.update(b"srs-dev-random")
+        pkg = Path(__file__).resolve().parents[1]
+        native = pkg.parent / "native"
+        deps = sorted(
+            str(p)
+            for pat in ("zk/*.py", "crypto/*.py", "crypto/native/*.py", "utils/*.py")
+            for p in pkg.glob(pat)
+        ) + sorted(str(p) for pat in ("*.cpp", "*.h") for p in native.glob(pat))
+        for dep in deps:
+            h.update(Path(dep).read_bytes())
+        key = h.hexdigest()[:32]
+        path = root / f"plonk-pk-{key}.pkl"
+
+        if path.exists():
+            try:
+                with open(path, "rb") as f:
+                    return pickle.load(f)
+            except Exception:
+                path.unlink(missing_ok=True)  # corrupt cache: recompute
+
+        if srs is None and srs_path is not None:
+            from .kzg import Setup
+
+            srs = Setup.from_bytes(Path(srs_path).read_bytes())
+        pk = plonk.compile_circuit(cs, srs=srs, k=k)
+        try:
+            root.mkdir(parents=True, exist_ok=True, mode=0o700)
+            tmp = path.with_name(f".{path.name}.{uuid.uuid4().hex}.tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump(pk, f, protocol=pickle.HIGHEST_PROTOCOL)
+            tmp.replace(path)
+        except OSError:
+            pass  # cache is best-effort; proving works without it
+        return pk
 
     @property
     def vk(self):

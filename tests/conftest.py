@@ -9,6 +9,14 @@ backend initializes.  bench.py is what runs on the real chip.
 """
 
 import os
+import pathlib
+
+# Keep the PLONK keygen cache repo-local: tests must not write pickles
+# into (or silently reuse stale keys from) the developer's home cache.
+os.environ.setdefault(
+    "PROTOCOL_TPU_CACHE",
+    str(pathlib.Path(__file__).resolve().parent.parent / ".cache" / "protocol_tpu"),
+)
 
 _platform = os.environ.get("PROTOCOL_TPU_TEST_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform

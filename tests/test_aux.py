@@ -8,7 +8,7 @@ import numpy as np
 from protocol_tpu.models.graphs import erdos_renyi
 from protocol_tpu.node.checkpoint import CheckpointStore
 from protocol_tpu.node.epoch import Epoch
-from protocol_tpu.node.manager import Manager
+from protocol_tpu.node.manager import Manager, ManagerConfig
 from protocol_tpu.node.server import handle_request
 from protocol_tpu.utils.telemetry import TELEMETRY, Telemetry
 
@@ -60,7 +60,7 @@ class TestCheckpointStore:
         from protocol_tpu.node.server import Node
         from protocol_tpu.zk.proof import ProofRaw
 
-        m = Manager()
+        m = Manager(ManagerConfig(prover="commitment"))
         m.generate_initial_attestations()
         m.calculate_proofs(Epoch(41))
         store = CheckpointStore(tmp_path)
@@ -76,6 +76,7 @@ class TestCheckpointStore:
                 epoch_interval=3600,
                 endpoint=((127, 0, 0, 1), 0),
                 checkpoint_dir=str(tmp_path),
+                prover="commitment",
             )
             node = Node.from_config(cfg)
             await node.start()
@@ -111,7 +112,7 @@ class TestTelemetry:
 
     def test_status_endpoint(self):
         TELEMETRY.reset()
-        m = Manager()
+        m = Manager(ManagerConfig(prover="commitment"))
         m.generate_initial_attestations()
         m.calculate_proofs(Epoch(9))
         status, body = handle_request("GET", "/status", m)

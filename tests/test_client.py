@@ -65,6 +65,7 @@ class TestClientAttest:
                 epoch_interval=3600,
                 endpoint=((127, 0, 0, 1), 0),
                 event_fixture=cfg.event_fixture,
+                prover="commitment",
             )
             node = Node.from_config(node_cfg)
             await node.start()

@@ -159,7 +159,7 @@ class TestManager:
     def test_should_calculate_proof(self):
         """manager/mod.rs:246-262: initial attestations converge to the
         initial scores."""
-        m = Manager()
+        m = Manager(ManagerConfig(prover="commitment"))
         m.generate_initial_attestations()
         epoch = Epoch(0)
         m.calculate_proofs(epoch)
@@ -168,7 +168,7 @@ class TestManager:
         assert m.prover.verify(proof.pub_ins, proof.proof)
 
     def test_get_last_proof(self):
-        m = Manager()
+        m = Manager(ManagerConfig(prover="commitment"))
         m.generate_initial_attestations()
         with pytest.raises(EigenError):
             m.get_last_proof()
@@ -189,7 +189,7 @@ class TestManager:
 
 class TestHandleRequest:
     def _ready_manager(self):
-        m = Manager()
+        m = Manager(ManagerConfig(prover="commitment"))
         m.generate_initial_attestations()
         m.calculate_proofs(Epoch(0))
         return m
@@ -238,7 +238,10 @@ class TestConfigAndFixtures:
             '{"prover": "plonk", "srs_path": "/tmp/srs.bin"}'
         )
         assert cfg.prover == "plonk" and cfg.srs_path == "/tmp/srs.bin"
-        assert ProtocolConfig.from_json("{}").prover == "commitment"
+        # A node proves real SNARKs by default, like the reference
+        # (manager/mod.rs:170-214).
+        assert ProtocolConfig.from_json("{}").prover == "plonk"
+        assert ProtocolConfig.from_json('{"prover": "commitment"}').prover == "commitment"
 
     def test_unknown_prover_rejected(self):
         import pytest
@@ -274,7 +277,9 @@ class TestNodeEndToEnd:
         from protocol_tpu.node.server import Node
 
         async def scenario():
-            cfg = ProtocolConfig(epoch_interval=3600, endpoint=((127, 0, 0, 1), 0))
+            cfg = ProtocolConfig(
+                epoch_interval=3600, endpoint=((127, 0, 0, 1), 0), prover="commitment"
+            )
             node = Node.from_config(cfg)
             await node.start()
             node.manager.calculate_proofs(Epoch(0))

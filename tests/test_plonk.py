@@ -3,10 +3,12 @@ real-proving ladder (circuit.rs:556-620 prove_and_verify): every proof
 is checked end-to-end through the KZG pairing, with tampered-proof and
 wrong-instance negatives.
 
-The full 5-peer epoch statement (k=14, ~70 s) runs when
+The full 5-peer epoch statement (k=14; ~8.4 s proving + ~13 s cold
+keygen, amortized by the on-disk key cache) runs when
 PROTOCOL_TPU_SLOW_TESTS=1; the default suite exercises the same
 machinery (chunked permutation, rotation gates, fixed columns,
-blinding) on smaller circuits.
+blinding) on smaller circuits, and drives one real 2-peer epoch →
+PLONK → EVM-verify flow through the Manager.
 """
 
 import os
@@ -249,9 +251,53 @@ class TestDomain:
         assert plonk._lagrange_eval(vals, x, k) == _eval_poly(coeffs, x)
 
 
+class TestEpochProofSmall:
+    """Default-suite flagship-path coverage: a real epoch → PLONK →
+    EVM-verify roundtrip through the Manager at the smallest viable
+    statement (2 peers, 1 iteration).  Keygen hits the on-disk key
+    cache after the first run."""
+
+    def test_manager_epoch_plonk_evm_roundtrip(self):
+        from protocol_tpu.node.bootstrap import FIXED_SET
+        from protocol_tpu.node.epoch import Epoch
+        from protocol_tpu.node.manager import Manager, ManagerConfig
+        from protocol_tpu.utils.telemetry import TELEMETRY
+        from protocol_tpu.zk.evm_verifier import evm_verify
+
+        mgr = Manager(
+            ManagerConfig(
+                prover="plonk",
+                num_neighbours=2,
+                num_iter=1,
+                fixed_set=list(FIXED_SET[:2]),
+            )
+        )
+        mgr.generate_initial_attestations()
+        epoch = Epoch(1)
+        mgr.calculate_proofs(epoch)
+        proof = mgr.cached_proofs[epoch]
+        assert mgr.prover.name == "plonk-kzg"
+        assert mgr.prover.verify(proof.pub_ins, proof.proof)
+        # Proving time must land in telemetry (the reference's
+        # "Proving time" print, circuit/src/utils.rs:305-321).
+        assert TELEMETRY.snapshot()["timers"]["epoch.prove"]["count"] >= 1
+        # On-chain leg: generate the EVM verifier for this circuit and
+        # verify the epoch proof on it (the epoch proof pins the
+        # quotient-chunk count, so no extra sample prove is needed).
+        from protocol_tpu.zk.evm_verifier import generate_evm_verifier, infer_n_t
+
+        vk = mgr.prover.vk
+        gen = generate_evm_verifier(vk, infer_n_t(vk, proof.proof), 2)
+        ok, gas = evm_verify(gen, proof.pub_ins, proof.proof)
+        assert ok and gas > 0
+        bad = [(proof.pub_ins[0] + 1) % P] + proof.pub_ins[1:]
+        assert not evm_verify(gen, bad, proof.proof)[0]
+
+
 @pytest.mark.skipif(
     not os.environ.get("PROTOCOL_TPU_SLOW_TESTS"),
-    reason="full 5-peer epoch proof takes ~70 s; set PROTOCOL_TPU_SLOW_TESTS=1",
+    reason="full 5-peer epoch proof: ~8.4 s prove + ~13 s cold keygen; "
+    "set PROTOCOL_TPU_SLOW_TESTS=1",
 )
 class TestEpochProof:
     def test_epoch_statement_real_proof(self):
