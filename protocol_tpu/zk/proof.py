@@ -179,6 +179,41 @@ class PlonkEpochProver(Prover):
             root = Path.home() / ".cache" / "protocol_tpu"
         root = Path(root)
 
+        def cache_usable() -> bool:
+            """Refuse to unpickle from (or write into) a cache dir that
+            isn't owner-only and owned by us — a writer there gets code
+            execution at boot, not just key substitution."""
+            try:
+                st = root.stat()
+            except FileNotFoundError:
+                return True  # will be created 0700 below
+            if st.st_uid != os.getuid() or st.st_mode & 0o077:
+                try:
+                    if st.st_uid == os.getuid():
+                        os.chmod(root, 0o700)
+                        return True
+                except OSError:
+                    pass
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "ignoring PLONK key cache at %s: directory must be "
+                    "owned by this user with mode 0700",
+                    root,
+                )
+                return False
+            return True
+
+        def load_srs():
+            if srs is None and srs_path is not None:
+                from .kzg import Setup
+
+                return Setup.from_bytes(Path(srs_path).read_bytes())
+            return srs
+
+        if not cache_usable():
+            return plonk.compile_circuit(cs, srs=load_srs(), k=k)
+
         h = hashlib.sha256()
         h.update(_json.dumps(self._params, sort_keys=True).encode())
         h.update(str(k).encode())
@@ -210,11 +245,7 @@ class PlonkEpochProver(Prover):
             except Exception:
                 path.unlink(missing_ok=True)  # corrupt cache: recompute
 
-        if srs is None and srs_path is not None:
-            from .kzg import Setup
-
-            srs = Setup.from_bytes(Path(srs_path).read_bytes())
-        pk = plonk.compile_circuit(cs, srs=srs, k=k)
+        pk = plonk.compile_circuit(cs, srs=load_srs(), k=k)
         try:
             root.mkdir(parents=True, exist_ok=True, mode=0o700)
             tmp = path.with_name(f".{path.name}.{uuid.uuid4().hex}.tmp")

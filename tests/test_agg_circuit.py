@@ -1,0 +1,113 @@
+"""Aggregation-fold circuit tests: transcript-chip parity with the
+native transcript, an end-to-end fold over real member PLONK proofs
+(constraint-checked in the default suite; really proved + EVM-verified
+in the slow tier), and tampered-member negatives.
+"""
+
+import os
+
+import pytest
+
+from protocol_tpu.crypto import field
+from protocol_tpu.zk import plonk
+from protocol_tpu.zk.agg_circuit import (
+    PoseidonTranscriptChip,
+    prepare_fold,
+    synthesize_fold,
+    verify_fold,
+)
+from protocol_tpu.zk.aggregator import Snark, finalize
+from protocol_tpu.zk.cs import ConstraintSystem
+from protocol_tpu.zk.gadgets import PoseidonChip, StdGate
+from protocol_tpu.zk.kzg import Setup
+from protocol_tpu.zk.transcript import PoseidonTranscript
+
+P = field.MODULUS
+
+
+class TestTranscriptChip:
+    def test_matches_native_transcript(self):
+        cs = ConstraintSystem()
+        std = StdGate(cs)
+        chip = PoseidonTranscriptChip(cs, std, PoseidonChip(cs))
+        native = PoseidonTranscript()
+
+        seq = [3, 1 << 100, P - 2, 7, 9, 11, 13, 17]
+        for v in seq[:3]:
+            chip.common_scalar(std.witness(v))
+            native.common_scalar(v)
+        c1 = chip.squeeze_challenge()
+        n1 = native.squeeze_challenge()
+        assert std.cell_value(c1) == n1
+        # Chained squeeze with more absorption in between.
+        for v in seq[3:]:
+            chip.common_scalar(std.witness(v))
+            native.common_scalar(v)
+        c2 = chip.squeeze_challenge()
+        n2 = native.squeeze_challenge()
+        assert std.cell_value(c2) == n2
+        # Back-to-back squeeze (re-absorbed challenge chains).
+        assert std.cell_value(chip.squeeze_challenge()) == native.squeeze_challenge()
+        cs.assert_satisfied()
+
+
+def _member_snarks(n=2, seed=b"agg"):
+    """Two small mul-add member proofs sharing one SRS (any PLONK
+    proofs aggregate; the epoch statement is just bigger)."""
+    from tests.test_plonk import _mul_add_circuit
+
+    srs = Setup.generate(6, seed=seed)
+    snarks = []
+    for i in range(n):
+        cs = _mul_add_circuit()
+        pk = plonk.compile_circuit(cs, srs=srs)
+        proof = plonk.prove(pk, cs, [17], seed=b"m%d" % i, transcript="poseidon")
+        snarks.append(Snark(vk=pk.vk, instances=[17], proof=proof))
+    return snarks
+
+
+BITS = 16  # test-tier batching width; production default is 128
+
+
+class TestFoldCircuit:
+    def test_fold_constraints_and_native_agreement(self):
+        snarks = _member_snarks()
+        stmt = prepare_fold(snarks, challenge_bits=BITS)
+        # The real (untruncated) accumulator also pairs correctly.
+        assert finalize(stmt.accumulator, snarks[0].vk)
+        cs = synthesize_fold(stmt)
+        cs.assert_satisfied()
+
+    def test_tampered_member_pair_unsatisfiable(self):
+        snarks = _member_snarks()
+        stmt = prepare_fold(snarks, challenge_bits=BITS)
+        # Claim a wrong deferred pair for member 0: shift B.
+        stmt.members[0].b = stmt.members[0].b.add(stmt.members[0].a)
+        with pytest.raises((AssertionError, ValueError)):
+            cs = synthesize_fold(stmt)
+            cs.assert_satisfied()
+
+    def test_tampered_challenge_unsatisfiable(self):
+        snarks = _member_snarks()
+        stmt = prepare_fold(snarks, challenge_bits=BITS)
+        stmt.members[0].challenge = (stmt.members[0].challenge + 1) % P
+        with pytest.raises(AssertionError):
+            cs = synthesize_fold(stmt)
+            cs.assert_satisfied()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PROTOCOL_TPU_SLOW_TESTS"),
+    reason="fold proof is a k~16 circuit (~1 min); set PROTOCOL_TPU_SLOW_TESTS=1",
+)
+class TestFoldProof:
+    def test_fold_proof_roundtrip(self):
+        snarks = _member_snarks()
+        stmt = prepare_fold(snarks, challenge_bits=BITS)
+        cs = synthesize_fold(stmt)
+        pk = plonk.compile_circuit(cs)
+        proof = plonk.prove(pk, cs, stmt.public_inputs(), transcript="poseidon")
+        assert verify_fold(pk.vk, snarks, proof, challenge_bits=BITS)
+        # A different member set must not verify against this proof.
+        other = _member_snarks(seed=b"agg2")
+        assert not verify_fold(pk.vk, other, proof, challenge_bits=BITS)
