@@ -37,7 +37,13 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..crypto import field
-from .aggregator import Accumulator, Snark
+from .aggregator import (
+    Accumulator,
+    Snark,
+    absorb_members,
+    check_shared_srs,
+    proof_chunks,
+)
 from .bn254 import G1
 from .cs import Cell, ConstraintSystem
 from .gadgets import PoseidonChip, StdGate
@@ -56,7 +62,7 @@ class PoseidonTranscriptChip:
     width-5 chunks; each squeezed challenge is re-absorbed so
     successive challenges chain."""
 
-    def __init__(self, cs: ConstraintSystem, std: StdGate, poseidon: PoseidonChip):
+    def __init__(self, std: StdGate, poseidon: PoseidonChip):
         self.std = std
         self.poseidon = poseidon
         self.zero = std.constant(0)
@@ -124,31 +130,14 @@ class FoldStatement:
         return pub
 
 
-def _proof_chunks(proof: bytes) -> list[int]:
-    return [
-        int.from_bytes(proof[i : i + 31], "little") for i in range(0, len(proof), 31)
-    ]
-
-
 def prepare_fold(snarks: list[Snark], challenge_bits: int = 128) -> FoldStatement:
-    """Native half of the fold (mirrors aggregator.accumulate, with the
-    truncated fold scalars the circuit uses): derive per-member
-    deferred pairs and transcript challenges, fold with rᵢ."""
-    if not snarks:
-        raise ValueError("nothing to fold")
-    srs = snarks[0].vk.srs
-    for s in snarks:
-        if s.vk.srs.g2 != srs.g2 or s.vk.srs.tau_g2 != srs.tau_g2:
-            raise ValueError("all member proofs must share one SRS")
-
+    """Native half of the fold (the same member-binding transcript as
+    aggregator.accumulate, with the truncated fold scalars the circuit
+    uses): derive per-member deferred pairs and transcript challenges,
+    fold with rᵢ."""
+    check_shared_srs(snarks)
     t = PoseidonTranscript()
-    for s in snarks:
-        t.common_scalar(s.vk.digest)
-        for v in s.instance_values():
-            t.common_scalar(v)
-        t.common_scalar(len(s.proof))
-        for chunk in _proof_chunks(s.proof):
-            t.common_scalar(chunk)
+    absorb_members(t, snarks)
 
     members: list[FoldWitness] = []
     lhs, rhs = G1(0, 0), G1(0, 0)
@@ -188,7 +177,7 @@ def synthesize_fold(stmt: FoldStatement) -> ConstraintSystem:
     poseidon = PoseidonChip(cs)
     integer = IntegerChip(cs, std)
     ecc = EccChip(cs, std, integer)
-    transcript = PoseidonTranscriptChip(cs, std, poseidon)
+    transcript = PoseidonTranscriptChip(std, poseidon)
 
     pub = stmt.public_inputs()
     inst_col = cs.column("instance", "instance")
@@ -201,7 +190,7 @@ def synthesize_fold(stmt: FoldStatement) -> ConstraintSystem:
         for v in m.instances:
             transcript.common_scalar(std.witness(v))
         transcript.common_scalar(std.constant(len(m.proof)))
-        for chunk in _proof_chunks(m.proof):
+        for chunk in proof_chunks(m.proof):
             transcript.common_scalar(std.witness(chunk))
 
     # Per member: challenge equality, pair points, scalar mul, fold.

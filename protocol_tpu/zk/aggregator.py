@@ -76,29 +76,50 @@ class Accumulator:
         return cls(lhs, rhs)
 
 
-def accumulate(snarks: list[Snark]) -> Accumulator | None:
-    """Fold the snarks' deferred pairing checks into one accumulator;
-    None when any snark fails a non-pairing check (bad transcript,
-    malformed points, constraint mismatch at the challenge)."""
+def proof_chunks(proof: bytes) -> list[int]:
+    """A proof blob as 31-byte little-endian field-sized chunks — the
+    transcript absorption unit shared by the native accumulator and the
+    in-circuit fold (agg_circuit.synthesize_fold must absorb the exact
+    same scalars)."""
+    return [
+        int.from_bytes(proof[i : i + 31], "little") for i in range(0, len(proof), 31)
+    ]
+
+
+def check_shared_srs(snarks: list[Snark]) -> None:
+    """Soundness precondition — must survive python -O."""
     if not snarks:
         raise ValueError("nothing to accumulate")
     srs = snarks[0].vk.srs
     for s in snarks:
-        # Soundness precondition — must survive python -O.
         if s.vk.srs.g2 != srs.g2 or s.vk.srs.tau_g2 != srs.tau_g2:
             raise ValueError("all member proofs must share one SRS")
+
+
+def absorb_members(t, snarks: list[Snark]) -> None:
+    """The member-binding absorption order (vk digest, instances,
+    proof length, proof chunks) — one definition for the native
+    accumulator AND the fold circuit's challenge derivation, so the
+    two can never drift apart."""
+    for s in snarks:
+        t.common_scalar(s.vk.digest)
+        for v in s.instance_values():
+            t.common_scalar(v)
+        t.common_scalar(len(s.proof))
+        for chunk in proof_chunks(s.proof):
+            t.common_scalar(chunk)
+
+
+def accumulate(snarks: list[Snark]) -> Accumulator | None:
+    """Fold the snarks' deferred pairing checks into one accumulator;
+    None when any snark fails a non-pairing check (bad transcript,
+    malformed points, constraint mismatch at the challenge)."""
+    check_shared_srs(snarks)
 
     # Challenge transcript binds every member (Poseidon, like the
     # reference's PoseidonRead accumulation transcript).
     t = PoseidonWrite()
-    for s in snarks:
-        t.write_scalar(s.vk.digest)
-        for v in s.instance_values():
-            t.write_scalar(v)
-        t.write_scalar(len(s.proof))
-        # Absorb the proof by 31-byte field-sized chunks.
-        for i in range(0, len(s.proof), 31):
-            t.write_scalar(int.from_bytes(s.proof[i : i + 31], "little"))
+    absorb_members(t, snarks)
 
     lhs, rhs = G1(0, 0), G1(0, 0)
     for s in snarks:

@@ -179,21 +179,40 @@ class PlonkEpochProver(Prover):
             root = Path.home() / ".cache" / "protocol_tpu"
         root = Path(root)
 
-        def cache_usable() -> bool:
-            """Refuse to unpickle from (or write into) a cache dir that
-            isn't owner-only and owned by us — a writer there gets code
-            execution at boot, not just key substitution."""
+        def load_srs():
+            if srs is None and srs_path is not None:
+                from .kzg import Setup
+
+                try:
+                    blob = Path(srs_path).read_bytes()
+                except OSError as e:
+                    raise FileNotFoundError(
+                        f"SRS file {srs_path!r} (config key 'srs_path') "
+                        f"could not be read: {e}"
+                    ) from e
+                return Setup.from_bytes(blob)
+            return srs
+
+        def open_cache_dir() -> int | None:
+            """Create-then-verify the cache directory on an fd so a
+            racing attacker can't swap in a loose-permission directory
+            between check and use (all entry IO goes through dir_fd).
+            Unpickling from a writable-by-others dir is code execution
+            at boot, not just key substitution."""
             try:
-                st = root.stat()
-            except FileNotFoundError:
-                return True  # will be created 0700 below
+                root.mkdir(parents=True, exist_ok=True, mode=0o700)
+                fd = os.open(root, os.O_RDONLY | os.O_DIRECTORY)
+            except OSError:
+                return None
+            st = os.fstat(fd)
             if st.st_uid != os.getuid() or st.st_mode & 0o077:
                 try:
                     if st.st_uid == os.getuid():
-                        os.chmod(root, 0o700)
-                        return True
+                        os.fchmod(fd, 0o700)
+                        return fd
                 except OSError:
                     pass
+                os.close(fd)
                 import logging
 
                 logging.getLogger(__name__).warning(
@@ -201,17 +220,11 @@ class PlonkEpochProver(Prover):
                     "owned by this user with mode 0700",
                     root,
                 )
-                return False
-            return True
+                return None
+            return fd
 
-        def load_srs():
-            if srs is None and srs_path is not None:
-                from .kzg import Setup
-
-                return Setup.from_bytes(Path(srs_path).read_bytes())
-            return srs
-
-        if not cache_usable():
+        dir_fd = open_cache_dir()
+        if dir_fd is None:
             return plonk.compile_circuit(cs, srs=load_srs(), k=k)
 
         h = hashlib.sha256()
@@ -236,25 +249,37 @@ class PlonkEpochProver(Prover):
         for dep in deps:
             h.update(Path(dep).read_bytes())
         key = h.hexdigest()[:32]
-        path = root / f"plonk-pk-{key}.pkl"
+        name = f"plonk-pk-{key}.pkl"
 
-        if path.exists():
-            try:
-                with open(path, "rb") as f:
-                    return pickle.load(f)
-            except Exception:
-                path.unlink(missing_ok=True)  # corrupt cache: recompute
-
-        pk = plonk.compile_circuit(cs, srs=load_srs(), k=k)
         try:
-            root.mkdir(parents=True, exist_ok=True, mode=0o700)
-            tmp = path.with_name(f".{path.name}.{uuid.uuid4().hex}.tmp")
-            with open(tmp, "wb") as f:
-                pickle.dump(pk, f, protocol=pickle.HIGHEST_PROTOCOL)
-            tmp.replace(path)
-        except OSError:
-            pass  # cache is best-effort; proving works without it
-        return pk
+            try:
+                f = os.fdopen(os.open(name, os.O_RDONLY, dir_fd=dir_fd), "rb")
+            except FileNotFoundError:
+                pass
+            else:
+                try:
+                    with f:
+                        return pickle.load(f)
+                except Exception:
+                    try:
+                        os.unlink(name, dir_fd=dir_fd)  # corrupt: recompute
+                    except OSError:
+                        pass
+
+            pk = plonk.compile_circuit(cs, srs=load_srs(), k=k)
+            try:
+                tmp = f".{name}.{uuid.uuid4().hex}.tmp"
+                with os.fdopen(
+                    os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600, dir_fd=dir_fd),
+                    "wb",
+                ) as f:
+                    pickle.dump(pk, f, protocol=pickle.HIGHEST_PROTOCOL)
+                os.rename(tmp, name, src_dir_fd=dir_fd, dst_dir_fd=dir_fd)
+            except OSError:
+                pass  # cache is best-effort; proving works without it
+            return pk
+        finally:
+            os.close(dir_fd)
 
     @property
     def vk(self):

@@ -29,7 +29,7 @@ class TestTranscriptChip:
     def test_matches_native_transcript(self):
         cs = ConstraintSystem()
         std = StdGate(cs)
-        chip = PoseidonTranscriptChip(cs, std, PoseidonChip(cs))
+        chip = PoseidonTranscriptChip(std, PoseidonChip(cs))
         native = PoseidonTranscript()
 
         seq = [3, 1 << 100, P - 2, 7, 9, 11, 13, 17]

@@ -1,0 +1,83 @@
+"""Committed deployment-artifact pinning tests.
+
+The reference ships its proving artifacts in data/ (params-14.bin, the
+et_verifier.bin contract, et_proof.json) and its client test verifies
+the committed proof against the committed verifier byte-for-byte
+(client/src/lib.rs:223-260).  These tests pin this repo's equivalents —
+data/srs-15.bin, data/et_verifier.bin, data/et_proof.json — so the
+wire format cannot drift between rounds without a deliberate
+regeneration (tools/gen_et_verifier.py).
+"""
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from protocol_tpu.crypto import field
+from protocol_tpu.zk.evm_verifier import GeneratedVerifier, evm_verify
+from protocol_tpu.zk.proof import ProofRaw
+
+DATA = Path(__file__).resolve().parent.parent / "data"
+P = field.MODULUS
+
+
+class TestCommittedArtifacts:
+    def test_committed_proof_verifies_on_committed_verifier(self):
+        gen = GeneratedVerifier.from_bytes((DATA / "et_verifier.bin").read_bytes())
+        proof = ProofRaw.from_json((DATA / "et_proof.json").read_text()).to_proof()
+        ok, gas = evm_verify(gen, proof.pub_ins, proof.proof)
+        assert ok and gas > 0
+
+    def test_committed_proof_tamper_rejected(self):
+        gen = GeneratedVerifier.from_bytes((DATA / "et_verifier.bin").read_bytes())
+        proof = ProofRaw.from_json((DATA / "et_proof.json").read_text()).to_proof()
+        bad_ins = [(proof.pub_ins[0] + 1) % P] + proof.pub_ins[1:]
+        assert not evm_verify(gen, bad_ins, proof.proof)[0]
+        bad = bytearray(proof.proof)
+        bad[7] ^= 1
+        assert not evm_verify(gen, proof.pub_ins, bytes(bad))[0]
+
+    def test_srs_artifact_well_formed(self):
+        """srs-15.bin parses, has 2^15 G1 powers, and its first powers
+        are pairing-consistent: e(g1[1], g2) == e(g1[0], tau_g2)."""
+        from protocol_tpu.zk.fields import pairing_check
+        from protocol_tpu.zk.kzg import Setup
+
+        srs = Setup.from_bytes((DATA / "srs-15.bin").read_bytes())
+        assert srs.k == 15 and len(srs.g1_powers) == 1 << 15
+        assert pairing_check(
+            [(srs.g1_powers[1], srs.g2), (srs.g1_powers[0].neg(), srs.tau_g2)]
+        )
+
+    def test_artifact_sizes_pinned(self):
+        """Shape parity with the reference's committed artifacts:
+        params-14.bin is 2,097,412 bytes; srs-15.bin carries the same
+        2MB G1 ladder (plus the G2 pair)."""
+        assert (DATA / "srs-15.bin").stat().st_size == 2_097_420
+        assert (DATA / "et_verifier.bin").stat().st_size > 10_000
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PROTOCOL_TPU_SLOW_TESTS"),
+    reason="keygen from the committed SRS (~14 s cold) + one epoch prove (~8 s); "
+    "set PROTOCOL_TPU_SLOW_TESTS=1",
+)
+class TestNodeServesCommittedFormat:
+    def test_fresh_epoch_proof_verifies_on_committed_verifier(self):
+        """A node booted on the committed SRS serves proofs the
+        committed on-chain verifier accepts — the round-trip the
+        reference's client test drives against Anvil."""
+        from protocol_tpu.node.epoch import Epoch
+        from protocol_tpu.node.manager import Manager, ManagerConfig
+
+        mgr = Manager(
+            ManagerConfig(prover="plonk", srs_path=str(DATA / "srs-15.bin"))
+        )
+        mgr.generate_initial_attestations()
+        mgr.calculate_proofs(Epoch(2))
+        proof = mgr.cached_proofs[Epoch(2)]
+        gen = GeneratedVerifier.from_bytes((DATA / "et_verifier.bin").read_bytes())
+        ok, gas = evm_verify(gen, proof.pub_ins, proof.proof)
+        assert ok and gas > 0
