@@ -48,6 +48,47 @@ def web3_transact(w3, tx: dict):
     return receipt
 
 
+class Web3Chain:  # pragma: no cover - web3 not in image
+    """Chain backend over web3.py with an unlocked dev account (e.g.
+    Anvil): the live counterpart of DevChainBackend."""
+
+    def __init__(self, node_url: str):
+        self._w3 = _web3(node_url)
+
+    def transact(self, to: str, calldata: bytes) -> bool:
+        w3 = self._w3
+        tx = {
+            "from": w3.eth.accounts[0],
+            "to": w3.to_checksum_address(to),
+            "data": "0x" + calldata.hex(),
+        }
+        try:
+            receipt = web3_transact(w3, tx)
+        except ClientError:
+            return False  # reverted
+        except Exception as e:  # gas-estimation revert surfaces pre-send
+            if "revert" in str(e).lower() or type(e).__name__ == "ContractLogicError":
+                return False
+            raise
+        del receipt  # web3_transact already raised on status != 1
+        return True
+
+
+class DevChainBackend:
+    """Chain backend over the in-process dev chain (evm/devchain.py) —
+    the Anvil analog the chain-integration tests drive."""
+
+    #: The unlocked "account 0" all transactions originate from.
+    SENDER = 0xDE5_0000_0000_0000_0000_0000_0000_0000_0CA11
+
+    def __init__(self, chain):
+        self._chain = chain
+
+    def transact(self, to: str, calldata: bytes) -> bool:
+        r = self._chain.transact(int(to, 16), calldata, sender=self.SENDER)
+        return r.success
+
+
 @dataclass
 class ClientConfig:
     """client-config.json shape (client/src/lib.rs:31-40)."""
@@ -107,6 +148,14 @@ class ClientConfig:
 class EigenTrustClient:
     config: ClientConfig
     user_secrets: list[BootstrapNode] = dc_field(default_factory=list)
+    #: Chain transaction backend; defaults to web3 over
+    #: ethereum_node_url, tests inject a DevChainBackend.
+    chain: object | None = None
+
+    def _chain_backend(self):
+        if self.chain is None:
+            self.chain = Web3Chain(self.config.ethereum_node_url)
+        return self.chain
 
     def _identity(self) -> SecretKey:
         return SecretKey.from_bs58(*self.config.secret_key)
@@ -144,25 +193,18 @@ class EigenTrustClient:
             with open(self.config.event_fixture, "a") as f:
                 f.write(event.to_json() + "\n")
             return event
-        return self._attest_web3(event)
+        return self._attest_chain(event)
 
-    def _attest_web3(self, event: AttestationCreatedEvent) -> AttestationCreatedEvent:
-        """Submit via eth_sendTransaction through web3 (requires web3 and
-        an unlocked dev account, e.g. Anvil)."""
+    def _attest_chain(self, event: AttestationCreatedEvent) -> AttestationCreatedEvent:
+        """Submit AttestationStation.attest through the chain backend
+        (client/src/lib.rs:103-119)."""
         from ..crypto.keccak import selector
 
-        w3 = _web3(self.config.ethereum_node_url)
         calldata = selector("attest((address,bytes32,bytes)[])") + abi_encode_attest(
             event.about, event.key, event.val
         )
-        web3_transact(
-            w3,
-            {
-                "from": w3.eth.accounts[0],
-                "to": w3.to_checksum_address(self.config.as_address),
-                "data": "0x" + calldata.hex(),
-            },
-        )
+        if not self._chain_backend().transact(self.config.as_address, calldata):
+            raise ClientError("attest transaction reverted")
         return event
 
     def fetch_proof(self) -> ProofRaw:
@@ -190,7 +232,7 @@ class EigenTrustClient:
         verifier/mod.rs:117-134), or with the commitment prover for
         commitment-backend nodes."""
         if self.use_chain():
-            return self._verify_web3(proof_raw)
+            return self._verify_chain(proof_raw)
         proof = proof_raw.to_proof()
         # Dispatch on the explicit backend tag when the node sent one;
         # for untagged (reference-format) payloads fall back to shape:
@@ -222,10 +264,10 @@ class EigenTrustClient:
             )
         return GeneratedVerifier.from_bytes(path.read_bytes())
 
-    def _verify_web3(self, proof_raw: ProofRaw) -> bool:
-        """Transact EtVerifierWrapper.verify(uint256[5], bytes)
-        (client/src/lib.rs:122-149).  A reverting verifier (bad proof)
-        returns False rather than raising."""
+    def _verify_chain(self, proof_raw: ProofRaw) -> bool:
+        """Transact EtVerifierWrapper.verify(uint256[5], bytes) through
+        the chain backend (client/src/lib.rs:122-149).  A reverting
+        wrapper (bad proof) returns False rather than raising."""
         from ..crypto.keccak import selector
 
         n = len(proof_raw.pub_ins)
@@ -233,7 +275,6 @@ class EigenTrustClient:
             raise ClientError(
                 f"wrapper expects {ET_WRAPPER_NUM_PUB_INS} public inputs, got {n}"
             )
-        w3 = _web3(self.config.ethereum_node_url)
         pub_words = b"".join(
             int.from_bytes(x, "little").to_bytes(32, "big") for x in proof_raw.pub_ins
         )
@@ -248,20 +289,9 @@ class EigenTrustClient:
             + proof
             + b"\x00" * ((-len(proof)) % 32)
         )
-        tx = {
-            "from": w3.eth.accounts[0],
-            "to": w3.to_checksum_address(self.config.et_verifier_wrapper_address),
-            "data": "0x" + calldata.hex(),
-        }
-        try:
-            receipt = web3_transact(w3, tx)
-        except ClientError:
-            return False  # wrapper reverted: VerificationFailed
-        except Exception as e:  # gas-estimation revert surfaces pre-send
-            if "revert" in str(e).lower() or type(e).__name__ == "ContractLogicError":
-                return False
-            raise
-        return receipt["status"] == 1
+        return self._chain_backend().transact(
+            self.config.et_verifier_wrapper_address, calldata
+        )
 
 
 def abi_encode_attest(about: str, key: bytes, val: bytes) -> bytes:

@@ -9,7 +9,7 @@ contracts and precompiles 0x05 (modexp), 0x06 (ecAdd), 0x07 (ecMul),
 close enough that reported verification gas is meaningful, which is all
 the reference's dbg!(gas_used) provides (verifier/mod.rs:123-130).
 
-No state trie, no value transfer, no logs: contracts are deployed
+No state trie, no value transfer: contracts are deployed
 either as raw runtime code or by executing creation code.
 """
 
@@ -265,11 +265,22 @@ class Precompiles:
 
 
 @dataclass
+@dataclass
+class Log:
+    """One emitted event: address, up to four topics, data blob."""
+
+    address: int
+    topics: list
+    data: bytes
+
+
+@dataclass
 class Receipt:
     success: bool
     returndata: bytes
     gas_used: int
     error: str | None = None
+    logs: list = dc_field(default_factory=list)
 
 
 _GAS = {  # metered subset (Istanbul-ish)
@@ -282,6 +293,7 @@ _GAS = {  # metered subset (Istanbul-ish)
     0x54: 800, 0x55: 20000, 0x56: 8, 0x57: 10, 0x58: 2, 0x59: 2, 0x5A: 2,
     0x5B: 1, 0x5F: 2,
     0xF3: 0, 0xFD: 0,
+    0xA0: 375, 0xA1: 750, 0xA2: 1125, 0xA3: 1500, 0xA4: 1875,
 }
 
 
@@ -312,11 +324,19 @@ class EVM:
 
     # -- calls ----------------------------------------------------------
 
-    def call(self, addr: int, calldata: bytes, gas: int = 30_000_000) -> Receipt:
+    #: Default msg.sender when none is given (a recognizable dummy).
+    DEFAULT_CALLER = 0xCA11E5
+
+    def call(
+        self, addr: int, calldata: bytes, gas: int = 30_000_000, caller: int | None = None
+    ) -> Receipt:
         code = self.code.get(addr)
         if code is None:
             raise EvmError(f"no contract at {addr:#x}")
-        return self._execute(code, bytes(calldata), gas, depth=0, self_addr=addr)
+        return self._execute(
+            code, bytes(calldata), gas, depth=0, self_addr=addr,
+            caller=self.DEFAULT_CALLER if caller is None else caller,
+        )
 
     # -- core loop ------------------------------------------------------
 
@@ -328,9 +348,13 @@ class EVM:
         depth: int,
         self_addr: int,
         static: bool = False,
+        caller: int | None = None,
     ) -> Receipt:
         if depth > 8:
             return Receipt(False, b"", 0, "call depth exceeded")
+        if caller is None:
+            caller = self.DEFAULT_CALLER
+        logs: list[Log] = []
         stack: list[int] = []
         mem = bytearray()
         ret_buf = b""
@@ -397,7 +421,7 @@ class EVM:
                     use(3)
 
                 if opcode == 0x00:  # STOP
-                    return Receipt(True, b"", gas - gas_left)
+                    return Receipt(True, b"", gas - gas_left, logs=logs)
                 elif opcode == 0x01:
                     push(pop() + pop())
                 elif opcode == 0x02:
@@ -475,7 +499,7 @@ class EVM:
                 elif opcode == 0x30:
                     push(self_addr)
                 elif opcode == 0x33:
-                    push(0xCA11E5)
+                    push(caller)
                 elif opcode == 0x34:
                     push(0)
                 elif opcode == 0x35:  # CALLDATALOAD
@@ -558,9 +582,16 @@ class EVM:
                     if len(stack) < i + 1:
                         raise EvmError("stack underflow")
                     stack[-1], stack[-1 - i] = stack[-1 - i], stack[-1]
+                elif 0xA0 <= opcode <= 0xA4:  # LOG0..LOG4
+                    if static:
+                        raise EvmError("log in static context")
+                    off, size = pop(), pop()
+                    use(8 * size)
+                    topics = [pop() for _ in range(opcode - 0xA0)]
+                    logs.append(Log(self_addr, topics, mread(off, size)))
                 elif opcode == 0xF3:  # RETURN
                     off, size = pop(), pop()
-                    return Receipt(True, mread(off, size), gas - gas_left)
+                    return Receipt(True, mread(off, size), gas - gas_left, logs=logs)
                 elif opcode == 0xFA:  # STATICCALL
                     use(700)
                     call_gas, to, in_off, in_size, out_off, out_size = (
@@ -585,7 +616,8 @@ class EVM:
                             use(sub_gas)
                     elif to in self.code:
                         r = self._execute(
-                            self.code[to], data, sub_gas, depth + 1, to, static=True
+                            self.code[to], data, sub_gas, depth + 1, to,
+                            static=True, caller=self_addr,
                         )
                         use(r.gas_used)
                         ok, out = r.success, r.returndata
@@ -602,7 +634,7 @@ class EVM:
                 else:  # pragma: no cover
                     raise EvmError(f"unhandled opcode {opcode:#04x}")
                 pc += 1
-            return Receipt(True, b"", gas - gas_left)
+            return Receipt(True, b"", gas - gas_left, logs=logs)
         except OutOfGas as e:
             return Receipt(False, b"", gas, str(e))
         except EvmError as e:

@@ -96,57 +96,120 @@ class FixtureEventSource:
             await asyncio.sleep(poll_interval)
 
 
-class Web3EventSource:
-    """Live AttestationCreated stream over JSON-RPC (ethers-equivalent
-    of server/src/ethereum.rs).  Requires web3.py at runtime."""
+class ChainEventSource:
+    """AttestationCreated replay/stream over an abstract RPC backend —
+    the ethers-equivalent of server/src/ethereum.rs, with the transport
+    pluggable so the same decode/replay/poll logic runs against web3
+    (live) or the in-process dev chain (evm/devchain.py, the Anvil
+    analog used in tests).
 
-    def __init__(self, node_url: str, contract_address: str):
-        try:
-            from web3 import Web3  # type: ignore
-        except ImportError as e:  # pragma: no cover - web3 not in image
-            raise RuntimeError(
-                "web3.py is not installed; use a FixtureEventSource or "
-                "install web3 for live chain ingestion"
-            ) from e
-        self._w3 = Web3(Web3.HTTPProvider(node_url))
+    The backend needs two methods:
+    ``block_number() -> int`` and
+    ``get_logs(address, from_block, to_block, topic0) -> iterable`` of
+    logs with ``topics: list[int]`` and ``data: bytes``.
+    """
+
+    def __init__(self, rpc, contract_address: str):
+        self._rpc = rpc
         self.contract_address = contract_address
 
-    def replay(self, from_block: int = 0, to_block=None) -> Iterator[AttestationCreatedEvent]:  # pragma: no cover
-        query = {
-            "fromBlock": from_block,
-            "address": self._w3.to_checksum_address(self.contract_address),
-            "topics": [ATTESTATION_CREATED_TOPIC],
-        }
-        if to_block is not None:
-            query["toBlock"] = to_block
-        for log in self._w3.eth.get_logs(query):
+    def replay(
+        self, from_block: int = 0, to_block=None
+    ) -> Iterator[AttestationCreatedEvent]:
+        logs = self._rpc.get_logs(
+            address=int(self.contract_address, 16),
+            from_block=from_block,
+            to_block=to_block,
+            topic0=int(ATTESTATION_CREATED_TOPIC, 16),
+        )
+        for log in logs:
             yield self._decode(log)
 
     @staticmethod
-    def _decode(log) -> AttestationCreatedEvent:  # pragma: no cover
-        data = bytes(log["data"])
+    def _decode(log) -> AttestationCreatedEvent:
+        data = bytes(log.data)
         # ABI: dynamic bytes → offset (32) + length (32) + payload.
         length = int.from_bytes(data[32:64], "big")
+        mask160 = (1 << 160) - 1
         return AttestationCreatedEvent(
-            creator="0x" + log["topics"][1].hex()[-40:],
-            about="0x" + log["topics"][2].hex()[-40:],
-            key=bytes(log["topics"][3]),
+            creator=f"0x{log.topics[1] & mask160:040x}",
+            about=f"0x{log.topics[2] & mask160:040x}",
+            key=log.topics[3].to_bytes(32, "big"),
             val=data[64 : 64 + length],
         )
 
-    async def stream(self, poll_interval: float = 2.0) -> AsyncIterator[AttestationCreatedEvent]:  # pragma: no cover
+    async def stream(
+        self, poll_interval: float = 2.0
+    ) -> AsyncIterator[AttestationCreatedEvent]:
         """Replay from block 0 (server/src/main.rs:139-143) then poll new
         blocks — the ethers event-stream analog over plain JSON-RPC."""
         import asyncio
 
         next_block = 0
         while True:
-            head = self._w3.eth.block_number
+            head = self._rpc.block_number()
             if head >= next_block:
                 for ev in self.replay(from_block=next_block, to_block=head):
                     yield ev
                 next_block = head + 1
             await asyncio.sleep(poll_interval)
+
+
+class DevChainRpc:
+    """RPC backend over the in-process dev chain (evm/devchain.py)."""
+
+    def __init__(self, chain):
+        self._chain = chain
+
+    def block_number(self) -> int:
+        return self._chain.eth_block_number()
+
+    def get_logs(self, address, from_block, to_block, topic0):
+        return self._chain.eth_get_logs(
+            address=address, from_block=from_block, to_block=to_block, topic0=topic0
+        )
+
+
+class _Web3Rpc:  # pragma: no cover - web3 not in image
+    """RPC backend over web3.py, normalizing HexBytes topics to ints."""
+
+    class _Log:
+        def __init__(self, raw):
+            self.topics = [int.from_bytes(bytes(t), "big") for t in raw["topics"]]
+            self.data = bytes(raw["data"])
+
+    def __init__(self, node_url: str):
+        from web3 import Web3  # type: ignore
+
+        self._w3 = Web3(Web3.HTTPProvider(node_url))
+
+    def block_number(self) -> int:
+        return self._w3.eth.block_number
+
+    def get_logs(self, address, from_block, to_block, topic0):
+        query = {
+            "fromBlock": from_block,
+            "address": self._w3.to_checksum_address(f"0x{address:040x}"),
+            "topics": [f"0x{topic0:064x}"],
+        }
+        if to_block is not None:
+            query["toBlock"] = to_block
+        return [self._Log(raw) for raw in self._w3.eth.get_logs(query)]
+
+
+class Web3EventSource(ChainEventSource):
+    """Live AttestationCreated stream over JSON-RPC via web3.py."""
+
+    def __init__(self, node_url: str, contract_address: str):
+        try:
+            rpc = _Web3Rpc(node_url)
+        except ImportError as e:  # pragma: no cover - web3 not in image
+            raise RuntimeError(
+                "web3.py is not installed; use a FixtureEventSource or a "
+                "DevChainRpc-backed ChainEventSource, or install web3 for "
+                "live chain ingestion"
+            ) from e
+        super().__init__(rpc, contract_address)
 
 
 def have_web3() -> bool:

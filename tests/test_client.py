@@ -20,7 +20,8 @@ DATA = Path(__file__).resolve().parents[1] / "data"
 
 def make_config(tmp_path, **overrides):
     cfg = ClientConfig.load(DATA / "client-config.json")
-    cfg.event_fixture = str(tmp_path / "events.jsonl")
+    if tmp_path is not None:
+        cfg.event_fixture = str(tmp_path / "events.jsonl")
     for k, v in overrides.items():
         setattr(cfg, k, v)
     return cfg
