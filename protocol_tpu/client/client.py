@@ -295,14 +295,9 @@ class EigenTrustClient:
 
 
 def abi_encode_attest(about: str, key: bytes, val: bytes) -> bytes:
-    """ABI-encode ``attest(AttestationData[])`` calldata for one entry:
-    (address about, bytes32 key, bytes val)[]."""
-    def word(x: int) -> bytes:
-        return x.to_bytes(32, "big")
+    """ABI-encode ``attest(AttestationData[])`` arguments for one entry
+    — delegates to the canonical batch encoder (evm/devchain.py) so the
+    layout has one definition."""
+    from ..evm.devchain import encode_attest_batch
 
-    about_b = bytes.fromhex(about.removeprefix("0x")).rjust(32, b"\x00")
-    val_padded = val + b"\x00" * ((-len(val)) % 32)
-    # outer: offset to array; array: len, offset to elem; elem: about,
-    # key, offset to bytes, bytes len, bytes data.
-    elem = about_b + key + word(0x60) + word(len(val)) + val_padded
-    return word(0x20) + word(1) + word(0x20) + elem
+    return encode_attest_batch([(about, key, val)])

@@ -116,6 +116,9 @@ def attestation_station_runtime() -> bytes:
     E([0x20, 0x40, "MSTORE"])
     E(["DUP1", 0x60, "MSTORE"])
     E(["DUP1", "DUP3", 32, "ADD", 0x80, "CALLDATACOPY"])   # calldatacopy(0x80, vptr+32, vlen)
+    # zero the ABI padding (a shorter val must not leak the previous
+    # iteration's bytes): mstore(0x80+vlen, 0)
+    E([0, "DUP2", 0x80, "ADD", "MSTORE"])
     # event data size = 0x40 + ceil32(vlen)   (DIV pops numerator first)
     E([32, "DUP2", 31, "ADD", "DIV", 32, "MUL", 0x40, "ADD"])  # [.., vptr, vlen, dsize]
     # topics: key, about, caller, sig  (LOG4 pops topics in order t1..t4
@@ -229,7 +232,13 @@ class DevChain:
         return r
 
     def call(self, to: int, data: bytes) -> Receipt:
-        return self.evm.call(to, data)
+        """eth_call semantics: runs on ephemeral state — storage writes
+        are rolled back and no block is mined."""
+        snapshot = {a: dict(s) for a, s in self.evm.storage.items()}
+        try:
+            return self.evm.call(to, data)
+        finally:
+            self.evm.storage = snapshot
 
     # -- the JSON-RPC-shaped surface the event source needs -------------
 
@@ -253,13 +262,22 @@ class DevChain:
         ]
 
 
-def encode_attest_calldata(batch: list[tuple[int, int, bytes]]) -> bytes:
-    """ABI-encode ``attest((address,bytes32,bytes)[])`` calldata for a
-    batch of (about, key, val) triples — the client-side encoding of
-    att_station.rs:54."""
-    head = ATTEST_SELECTOR.to_bytes(4, "big") + (0x20).to_bytes(32, "big")
+def _word(x) -> bytes:
+    """One ABI word from an int, bytes32, or 0x-hex address string."""
+    if isinstance(x, bytes):
+        return x.rjust(32, b"\0")
+    if isinstance(x, str):
+        return bytes.fromhex(x.removeprefix("0x")).rjust(32, b"\0")
+    return int(x).to_bytes(32, "big")
+
+
+def encode_attest_batch(batch: list[tuple]) -> bytes:
+    """The canonical ``attest((address,bytes32,bytes)[])`` argument
+    encoding (no selector) for (about, key, val) triples — shared by
+    the dev chain tests and the client's chain submission so the ABI
+    layout has exactly one definition (att_station.rs:54 parity)."""
     n = len(batch)
-    body = n.to_bytes(32, "big")
+    body = _word(n)
     offsets = []
     elems = []
     off = 32 * n
@@ -267,14 +285,14 @@ def encode_attest_calldata(batch: list[tuple[int, int, bytes]]) -> bytes:
         offsets.append(off)
         pad = (-len(val)) % 32
         elem = (
-            about.to_bytes(32, "big")
-            + key.to_bytes(32, "big")
-            + (0x60).to_bytes(32, "big")
-            + len(val).to_bytes(32, "big")
-            + val
-            + b"\0" * pad
+            _word(about) + _word(key) + _word(0x60) + _word(len(val)) + val + b"\0" * pad
         )
         elems.append(elem)
         off += len(elem)
-    body += b"".join(o.to_bytes(32, "big") for o in offsets) + b"".join(elems)
-    return head + body
+    body += b"".join(_word(o) for o in offsets) + b"".join(elems)
+    return _word(0x20) + body
+
+
+def encode_attest_calldata(batch: list[tuple]) -> bytes:
+    """Selector-prefixed attest() calldata for the dev chain."""
+    return ATTEST_SELECTOR.to_bytes(4, "big") + encode_attest_batch(batch)

@@ -265,7 +265,6 @@ class Precompiles:
 
 
 @dataclass
-@dataclass
 class Log:
     """One emitted event: address, up to four topics, data blob."""
 

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Build the native runtime from source (the reference's build.sh analog).
+# No binaries are committed; the Python loaders also rebuild on demand.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+make -C native

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Lint wall (the reference's fmt-check.sh + clippy.sh analog,
+# .github/workflows/test.yml:32-37).  Runs the full ruff+mypy wall when
+# the tools exist; always runs the bytecode-compile floor so even
+# tool-less images (like the build image) get a syntax/structure gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q protocol_tpu tests tools bench.py __graft_entry__.py
+
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+    ruff format --check .
+else
+    echo "lint: ruff not installed; ran compileall floor only" >&2
+fi
+if command -v mypy >/dev/null 2>&1; then
+    mypy protocol_tpu
+else
+    echo "lint: mypy not installed; skipped type gate" >&2
+fi

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Full test suite (the reference's scripts/test.sh: cargo test --all).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q "$@"

@@ -61,6 +61,29 @@ class TestAttestationStationContract:
         assert not chain.transact(addr, b"\x00\x01\x02\x03", 1).success
         assert chain.block_number == 1  # reverted tx does not mine
 
+    def test_batch_padding_does_not_leak_previous_val(self):
+        """A shorter val after a longer one must emit zero ABI padding,
+        not residue from the previous iteration's memory."""
+        chain, addr = _station_chain()
+        r = chain.transact(
+            addr,
+            encode_attest_calldata([(1, 2, b"A" * 40), (3, 4, b"B" * 5)]),
+            9,
+        )
+        assert r.success
+        second = chain.logs[1].data
+        assert second[64:69] == b"B" * 5
+        assert second[69:96] == b"\0" * 27  # padding, not b"A" residue
+
+    def test_call_is_ephemeral(self):
+        """eth_call semantics: a query never mutates storage or mines."""
+        chain, addr = _station_chain()
+        before_blocks = chain.block_number
+        chain.call(addr, encode_attest_calldata([(7, 8, b"query-only")]))
+        assert chain.block_number == before_blocks
+        assert chain.evm.storage.get(addr, {}) == {}
+        assert chain.logs == []
+
 
 class TestEventSourceOverDevChain:
     def test_client_attest_node_replay_roundtrip(self):
