@@ -198,11 +198,9 @@ class EigenTrustClient:
     def _attest_chain(self, event: AttestationCreatedEvent) -> AttestationCreatedEvent:
         """Submit AttestationStation.attest through the chain backend
         (client/src/lib.rs:103-119)."""
-        from ..crypto.keccak import selector
+        from ..evm.devchain import encode_attest_calldata
 
-        calldata = selector("attest((address,bytes32,bytes)[])") + abi_encode_attest(
-            event.about, event.key, event.val
-        )
+        calldata = encode_attest_calldata([(event.about, event.key, event.val)])
         if not self._chain_backend().transact(self.config.as_address, calldata):
             raise ClientError("attest transaction reverted")
         return event

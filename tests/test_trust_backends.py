@@ -197,3 +197,16 @@ class TestBackendRegistry:
     def test_all_named_backends_construct(self):
         for name in ("native-cpu", "tpu-dense", "tpu-sparse", "tpu-sharded"):
             assert get_backend(name).name == name
+
+
+class TestBenchLadder:
+    def test_ladder_smoke(self):
+        """All five BASELINE.md configs execute and report: shape check
+        at 1/1000 scale (bench.py --ladder is the real run)."""
+        import bench
+
+        entries = bench.ladder(scale_div=1000, iters=6)
+        assert [e["config"][:2] for e in entries] == ["1-", "2-", "3-", "4-", "5-"]
+        curve = entries[-1]["sybil_mass_curve"]
+        masses = [p["sybil_mass"] for p in curve]
+        assert masses == sorted(masses, reverse=True)  # damping squeezes the clique
