@@ -83,8 +83,13 @@ def headline_entry(iters: int = 40) -> dict:
 
 
 def ladder(scale_div: int = 1, iters: int = 40) -> list[dict]:
-    """The five BASELINE.md configs, each timed end to end (compile and
-    host graph assembly excluded; convergence wall-clock reported).
+    """The five BASELINE.md configs.
+
+    Configs 1-3 and 5 time one ``backend.converge`` call after a warm-up
+    call has compiled the kernel — the timed region therefore includes
+    host-side normalization/sorting and the host->device transfer (the
+    backend API bundles them), unlike the headline config 4 which
+    pre-stages device arrays and times only the iteration loop.
     ``iters`` scales the per-config iteration count (tests shrink it)."""
     from pathlib import Path
 

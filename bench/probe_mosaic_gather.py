@@ -1,22 +1,41 @@
-"""Probe dynamic_gather support envelope: axis1 (lane) range scaling,
-axis0 (sublane) shapes, transpose support, small-table XLA gather."""
+"""Probe the Mosaic dynamic_gather support envelope: axis1 (lane)
+range scaling, axis0 (sublane) shapes, transposes, and XLA gather
+speed vs table size.
+
+Recorded output (TPU v5 lite via axon tunnel, jax 0.9.0, 2026-07-29;
+timings of successful cases are dominated by the tunnel's ~70 ms
+dispatch — use bench/profile_components.py-style dependent chains for
+real op costs):
+
+    axis1 (8192,128) range=128: compiles, correct
+    axis1 range 1024/8192/16384/131072/1048576: Mosaic compiler crash
+    axis0 (8,128) range=8: compiles, correct
+    axis0 range 64/256/1024/8192: Mosaic compiler crash
+    transpose (128,8192) and (8192,128): compile, correct
+    XLA gather 8M indices: 124.2 / 123.3 / 124.4 ms from 16K / 131K /
+    1M-entry tables — table-size independent (op-bound)
+
+Conclusion (PERF.md §1): cross-vreg dynamic gathers are unusable on
+this toolchain, which rules out a VMEM-resident-table Pallas gather
+for the 1M-entry score table.
+"""
+import pathlib
+import sys
 import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 import jax, jax.numpy as jnp, numpy as np
 from jax.experimental import pallas as pl
 
 rng = np.random.default_rng(0)
 
 
-def bench_gather(axis, R, L, rng_hi=None, reps=20):
-    rng_hi = rng_hi if rng_hi is not None else (R if axis == 0 else L)
+def bench_gather(axis, R, L, reps=20):
+    rng_hi = R if axis == 0 else L
     name = f"axis{axis} ({R},{L}) range={rng_hi}"
     try:
         t = jax.device_put(jnp.asarray(rng.random((R, L), dtype=np.float32)))
-        if axis == 0:
-            idx = rng.integers(0, rng_hi, (R, L)).astype(np.int32)
-        else:
-            idx = rng.integers(0, rng_hi, (R, L)).astype(np.int32)
-        idx = jax.device_put(jnp.asarray(idx))
+        idx = jax.device_put(jnp.asarray(rng.integers(0, rng_hi, (R, L)).astype(np.int32)))
         f = jax.jit(pl.pallas_call(
             lambda t_ref, i_ref, o_ref: o_ref.__setitem__(
                 slice(None), jnp.take_along_axis(t_ref[:], i_ref[:], axis=axis)),

@@ -1,13 +1,26 @@
-"""LICM-defeated component breakdown + in-register primitive costs.
+"""LICM-defeated component breakdown of the headline bench step.
 
 Every loop body depends on the carry so WhileLoopInvariantCodeMotion
-cannot hoist the op being measured.
+cannot hoist the op being measured — without this, XLA hoists any
+loop-invariant gather and the "benchmark" times dispatch overhead
+(PERF.md §1 documents both the trap and the numbers).
+
+Recorded output (TPU v5 lite via axon tunnel, 2026-07-29):
+
+    gather 50M (dep): 386.08 ms/iter  (3089 ms total)
+    w*gather 50M (dep): 385.75 ms/iter  (3086 ms total)
+    rowsum_sorted 50M (dep): 65.68 ms/iter  (525 ms total)
+    50M elementwise mul (dep): 8.81 ms/iter  (71 ms total)
+
+Conclusion: the bench is gather-op-bound (86 % of the 447 ms step).
 """
-import sys, time
-sys.path.insert(0, "/root/repo")
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 import jax, jax.numpy as jnp, numpy as np
 from jax import lax
-from jax.experimental import pallas as pl
 from protocol_tpu.ops.sparse import rowsum_sorted
 
 rng = np.random.default_rng(0)
@@ -48,35 +61,3 @@ timeit("w*gather 50M (dep)", dep_chain(lambda d, t, s, w: (w * (t + d)[s]).max()
 timeit("rowsum_sorted 50M (dep)", dep_chain(
     lambda d, c, rp: rowsum_sorted(c + d, rp).max()), contrib, row_ptr)
 timeit("50M elementwise mul (dep)", dep_chain(lambda d, c, w: ((c + d) * w).max()), contrib, w)
-
-# in-register primitive costs: K chained gathers on one vreg inside a kernel
-K = 512
-idxc = jax.device_put(jnp.asarray(rng.integers(0, 128, (8, 128)).astype(np.int32)))
-
-def k_lane(i_ref, o_ref):
-    x = i_ref[:]
-    for _ in range(K):
-        x = jnp.take_along_axis(idx_tbl, x, axis=1)
-    o_ref[:] = x
-
-idx_tbl_np = rng.integers(0, 128, (8, 128)).astype(np.int32)
-idx_tbl = jnp.asarray(idx_tbl_np)
-
-lane_k = pl.pallas_call(k_lane, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))
-try:
-    timeit(f"lane-gather x{K} on one vreg", lambda i: lane_k(i), idxc, per=K, reps=3)
-except Exception as e:
-    print(f"lane chain: FAILED {type(e).__name__}: {str(e).splitlines()[0][:160]}", flush=True)
-
-def k_sub(i_ref, o_ref):
-    x = i_ref[:]
-    for _ in range(K):
-        x = jnp.take_along_axis(idx_tbl8, x % 8, axis=0)
-    o_ref[:] = x
-
-idx_tbl8 = jnp.asarray(rng.integers(0, 128, (8, 128)).astype(np.int32))
-sub_k = pl.pallas_call(k_sub, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))
-try:
-    timeit(f"sublane-gather x{K} on one vreg", lambda i: sub_k(i), idxc, per=K, reps=3)
-except Exception as e:
-    print(f"sublane chain: FAILED {type(e).__name__}: {str(e).splitlines()[0][:160]}", flush=True)

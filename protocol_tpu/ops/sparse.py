@@ -89,7 +89,7 @@ def rowsum_sorted(contrib: jax.Array, row_ptr: jax.Array) -> jax.Array:
     TPU scatter (what ``segment_sum`` lowers to) serializes on
     destination indices even when they are sorted.  Measured on the
     v5e at full bench scale (1M peers / 50M edges, 40 iters,
-    .scratch/prof6_decide.py + PERF.md §1): the end-to-end COO
+    PERF.md §1): the end-to-end COO
     segment_sum convergence runs 42.4 s vs 17.9 s for this cumsum
     formulation (2.4×); the op-level gap is larger at smaller scales
     (7.5× end-to-end at 200K peers / 10M edges).  Within each

@@ -6,7 +6,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-python -m compileall -q protocol_tpu tests tools bench.py __graft_entry__.py
+python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.py
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check .
