@@ -17,6 +17,7 @@
 #include "constants.h"
 #include "zk_common.h"
 
+#include <cassert>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -677,7 +678,7 @@ void zk_msm(const uint64_t *scalars, const uint64_t *points, int64_t n, uint64_t
 
         // Per-round scratch for the batched affine additions.
         std::vector<int32_t> badd;       // bucket indices with a real add
-        std::vector<FqF> nx, ny, denom, pref;
+        std::vector<FqF> nx, ny, denom, pref, dinv, lam;
         badd.reserve(n_buckets);
 
         for (int32_t r = 0; r < maxcount; ++r) {
@@ -750,31 +751,42 @@ void zk_msm(const uint64_t *scalars, const uint64_t *points, int64_t n, uint64_t
             }
             FqF inv_all;
             FqF::inv(inv_all, acc);
+            // The field mul is latency-bound on dependent chains (78
+            // cycles) but ~18 cycles at 4-way ILP, so the application
+            // runs in stage passes whose iterations are independent —
+            // the out-of-order core overlaps adjacent elements.  Only
+            // the dinv sweep keeps a (2-mul) serial chain.
+            dinv.resize(m);
+            lam.resize(m);
             for (size_t j = m; j-- > 0;) {
-                FqF dinv;
-                FqF::mul(dinv, inv_all, pref[j]);
+                FqF::mul(dinv[j], inv_all, pref[j]);
                 FqF::mul(inv_all, inv_all, denom[j]);
+            }
+            for (size_t j = 0; j < m; ++j) {
                 int32_t b = badd[j];
                 if (kind[j] == 2) continue;
-                FqF lam;
                 if (kind[j] == 1) {
                     // lambda = 3 x^2 / 2y
                     FqF x2, num;
                     FqF::sqr(x2, bx[b]);
                     FqF::add(num, x2, x2);
                     FqF::add(num, num, x2);
-                    FqF::mul(lam, num, dinv);
+                    FqF::mul(lam[j], num, dinv[j]);
                 } else {
                     FqF dy;
                     FqF::sub(dy, ny[j], by[b]);
-                    FqF::mul(lam, dy, dinv);
+                    FqF::mul(lam[j], dy, dinv[j]);
                 }
+            }
+            for (size_t j = 0; j < m; ++j) {
+                int32_t b = badd[j];
+                if (kind[j] == 2) continue;
                 FqF l2, x3, y3, t;
-                FqF::sqr(l2, lam);
+                FqF::sqr(l2, lam[j]);
                 FqF::sub(x3, l2, bx[b]);
                 FqF::sub(x3, x3, (kind[j] == 1) ? bx[b] : nx[j]);
                 FqF::sub(t, bx[b], x3);
-                FqF::mul(y3, lam, t);
+                FqF::mul(y3, lam[j], t);
                 FqF::sub(y3, y3, by[b]);
                 bx[b] = x3;
                 by[b] = y3;
@@ -782,14 +794,37 @@ void zk_msm(const uint64_t *scalars, const uint64_t *points, int64_t n, uint64_t
         }
 
         // Running-sum reduction: sum_b (b+1) * bucket[b], folding the
-        // Jacobian shadows in as we pass each bucket.
-        G1J acc, partial;
-        g1_set_identity(acc);
-        g1_set_identity(partial);
-        for (int64_t b = n_buckets - 1; b >= 0; --b) {
-            if (occ[b]) g1_add_affine(acc, acc, bx[b], by[b]);
-            if (shadow_used[b]) g1_add(acc, acc, shadow[b]);
-            g1_add(partial, partial, acc);
+        // Jacobian shadows in as we pass each bucket.  Split into four
+        // contiguous segments whose running sums are independent chains
+        // (the point-add latency is ~4x its throughput, so interleaving
+        // four chains in one loop body lets the core overlap them):
+        //   partial = sum_s part_s + sum_s (s*L)*acc_s,  L = B/4.
+        const int64_t L = n_buckets / 4;
+        // The segment math needs 4 | n_buckets and L a power of two
+        // (guaranteed by n_buckets = 2^(c-1), c >= 5); fail loudly if a
+        // future window heuristic breaks that.
+        assert((n_buckets & 3) == 0 && (L & (L - 1)) == 0);
+        G1J accs[4], parts[4];
+        for (int s = 0; s < 4; ++s) {
+            g1_set_identity(accs[s]);
+            g1_set_identity(parts[s]);
+        }
+        for (int64_t i = L - 1; i >= 0; --i) {
+            for (int s = 0; s < 4; ++s) {
+                int64_t b = s * L + i;
+                if (occ[b]) g1_add_affine(accs[s], accs[s], bx[b], by[b]);
+                if (shadow_used[b]) g1_add(accs[s], accs[s], shadow[b]);
+                g1_add(parts[s], parts[s], accs[s]);
+            }
+        }
+        G1J partial = parts[0];
+        for (int s = 1; s < 4; ++s) {
+            g1_add(partial, partial, parts[s]);
+            // (s*L)*acc_s: s*acc_s by repeated addition, then L doublings.
+            G1J t = accs[s];
+            for (int k = 1; k < s; ++k) g1_add(t, t, accs[s]);
+            for (int64_t l = L; l > 1; l >>= 1) g1_double(t, t);
+            g1_add(partial, partial, t);
         }
         window_sums[w] = partial;
     }

@@ -158,7 +158,9 @@ class Node:
                 )
         TELEMETRY.count("epochs")
 
-    async def _epoch_loop(self):
+    async def _epoch_loop(self, warm=None):
+        if warm is not None:
+            await warm  # boot keygen must land before the first prove
         interval = self.config.epoch_interval
         while True:
             await asyncio.sleep(Epoch.secs_until_next_epoch(interval))
@@ -227,11 +229,19 @@ class Node:
         if self.config.checkpoint_dir:
             self._restore_checkpoint()
         self.manager.generate_initial_attestations()
+        # Boot-time keygen, like the reference's MANAGER_STORE init
+        # (server/src/main.rs:70-83): runs in an executor so the HTTP
+        # socket comes up while the (cached ~0.7 s / cold ~13 s) PLONK
+        # key loads; the epoch loop awaits it before the first tick so
+        # proving never pays keygen.
+        warm = asyncio.get_running_loop().run_in_executor(
+            None, self.manager.warm_prover
+        )
         self._server = await asyncio.start_server(
             self._handle_conn, self.config.host, self.config.port
         )
         self._tasks = [
-            asyncio.create_task(self._epoch_loop()),
+            asyncio.create_task(self._epoch_loop(warm)),
             asyncio.create_task(self._event_loop()),
         ]
         log.info("listening on http://%s:%s", self.config.host, self.config.port)
