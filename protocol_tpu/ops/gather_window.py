@@ -1,0 +1,139 @@
+"""Windowed Pallas gather: score-table lookups from VMEM using only the
+Mosaic primitives that compile on this toolchain.
+
+PERF.md §1 establishes that XLA's TPU gather runs at ~7 cycles/element
+(386 ms per 50M-edge iteration, 86 % of the bench step) and that
+Mosaic's general cross-vreg dynamic gather crashes the compiler.  What
+*does* compile: dynamic sublane slicing of a VMEM ref, range-8 sublane
+`take_along_axis`, range-128 lane `take_along_axis`, broadcasts, and
+selects.  This kernel composes exactly those into a windowed gather:
+
+- Host side (`bucket_by_window`, one-time per graph): edges are
+  grouped so every 1024-edge vreg-row shares one 1024-entry window of
+  the table (`src // 1024`); rows are padded with window-local zeros
+  and a weight mask.
+- Kernel side (`gather_windowed`): the 4 MB score table lives in VMEM
+  as (8192, 128); per vreg-row the kernel dynamic-slices the (8, 128)
+  window and resolves the 1024 local indices with an 8-way
+  broadcast/lane-gather/select chain (~30 vreg ops per 1024 edges).
+
+The output is in *bucket order*, not dst order — PERF.md §1 documents
+why that prevents fusing this kernel into the full CSR pipeline (the
+rowsum needs dst order and the bridging permutation is itself a random
+gather).  The kernel stands as the best-achievable custom gather on
+this toolchain, and becomes directly usable if a future Mosaic fixes
+cross-vreg `dynamic_gather` (then the bucketing constraint drops).
+
+Correctness is validated in interpret mode on CPU (tests); wall-clock
+on the real chip is queued on TPU availability (PERF.md §5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+#: Window width in table entries: one (8, 128) VMEM tile.
+WINDOW = 1024
+#: Edges per vreg-row (must equal WINDOW for the two-step resolve).
+ROW = 1024
+#: Vreg-rows per grid step.
+BLOCK_ROWS = 64
+
+
+def bucket_by_window(src: np.ndarray, w: np.ndarray) -> dict:
+    """Group edges so each 1024-edge vreg-row shares one src window.
+
+    Returns arrays shaped for ``gather_windowed`` plus the mapping back
+    to input edges: for the k-th edge of the window-sorted order,
+    ``contrib_input[order[k]] = contrib_bucketed[out_pos[k]]`` —
+    ``out_pos`` accounts for the per-window padding, which carries
+    weight 0.
+    """
+    e = src.shape[0]
+    window = src.astype(np.int64) // WINDOW
+    order = np.argsort(window, kind="stable").astype(np.int64)
+    sorted_win = window[order]
+    # Rows per window bucket, each padded to a full vreg-row.
+    uniq, counts = np.unique(sorted_win, return_counts=True)
+    rows_per = -(-counts // ROW)
+    total_rows = int(rows_per.sum())
+    # Pad to the grid's block granularity.
+    total_rows = -(-total_rows // BLOCK_ROWS) * BLOCK_ROWS
+    local = np.zeros(total_rows * ROW, np.int32)
+    weight = np.zeros(total_rows * ROW, np.float32)
+    out_pos = np.zeros(e, np.int64)  # bucketed position of input edge order[k]
+    wid = np.zeros(total_rows, np.int32)
+    row = 0
+    off = 0
+    for u, c in zip(uniq, counts):
+        idx = order[off : off + c]
+        base = row * ROW
+        local[base : base + c] = (src[idx] % WINDOW).astype(np.int32)
+        weight[base : base + c] = w[idx]
+        out_pos[off : off + c] = base + np.arange(c)
+        nrows = -(-c // ROW)
+        wid[row : row + nrows] = u
+        row += nrows
+        off += c
+    return {
+        "local": local.reshape(total_rows * 8, 128),
+        "weight": weight.reshape(total_rows * 8, 128),
+        "wid": wid,
+        "order": order,
+        "out_pos": out_pos,
+        "n_rows": total_rows,
+    }
+
+
+def _kernel(wid_ref, t_ref, local_ref, w_ref, out_ref):
+    """One grid step: BLOCK_ROWS vreg-rows of 1024 edges each."""
+    for v in range(BLOCK_ROWS):
+        win = t_ref[pl.ds(wid_ref[v] * 8, 8), :]  # (8,128) window slice
+        lidx = local_ref[pl.ds(v * 8, 8), :]
+        sub = lidx // 128
+        lane = lidx % 128
+        acc = jnp.zeros((8, 128), jnp.float32)
+        for k in range(8):
+            rowk = jnp.broadcast_to(win[k : k + 1, :], (8, 128))
+            g = jnp.take_along_axis(rowk, lane, axis=1)
+            acc = jnp.where(sub == k, g, acc)
+        out_ref[pl.ds(v * 8, 8), :] = acc * w_ref[pl.ds(v * 8, 8), :]
+
+
+@partial(jax.jit, static_argnames=("n_rows", "interpret"))
+def gather_windowed(
+    wid: jax.Array,
+    table: jax.Array,
+    local: jax.Array,
+    weight: jax.Array,
+    *,
+    n_rows: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """``out[r, j] = weight[r, j] * table[wid[r//8]*1024 + local[r, j]]``
+    with the table resident in VMEM as (8192, 128)."""
+    assert table.size % WINDOW == 0
+    assert n_rows % BLOCK_ROWS == 0, (
+        f"n_rows must be a multiple of {BLOCK_ROWS} (bucket_by_window pads "
+        "to this); a partial trailing block would be silently unwritten"
+    )
+    t2d = table.reshape(-1, 128)
+    n_blocks = n_rows // BLOCK_ROWS
+    return pl.pallas_call(
+        _kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS,), lambda i: (i,)),
+            pl.BlockSpec(t2d.shape, lambda i: (0, 0)),
+            pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_rows * 8, 128), jnp.float32),
+        interpret=interpret,
+    )(wid, t2d, local, weight)

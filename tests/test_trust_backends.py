@@ -210,3 +210,35 @@ class TestBenchLadder:
         curve = entries[-1]["sybil_mass_curve"]
         masses = [p["sybil_mass"] for p in curve]
         assert masses == sorted(masses, reverse=True)  # damping squeezes the clique
+
+
+class TestWindowedGather:
+    def test_bucketed_gather_matches_direct(self):
+        """The windowed Pallas gather (interpret mode on CPU; PERF.md
+        §1 documents the TPU compilation envelope it is built for)
+        reproduces w * t[src] under the bucket permutation."""
+        import numpy as np
+
+        from protocol_tpu.ops.gather_window import bucket_by_window, gather_windowed
+
+        rng = np.random.default_rng(5)
+        n, e = 1 << 13, 1 << 15
+        src = rng.integers(0, n, e).astype(np.int32)
+        w = rng.random(e, dtype=np.float32)
+        t = rng.random(n, dtype=np.float32)
+
+        b = bucket_by_window(src, w)
+        out = np.asarray(
+            gather_windowed(
+                jnp.asarray(b["wid"]),
+                jnp.asarray(t),
+                jnp.asarray(b["local"]),
+                jnp.asarray(b["weight"]),
+                n_rows=b["n_rows"],
+                interpret=True,
+            )
+        ).reshape(-1)
+        expect = w[b["order"]] * t[src[b["order"]]]
+        np.testing.assert_allclose(out[b["out_pos"]], expect, rtol=1e-6)
+        # Padding slots carry zero weight, so the bucketed sum matches.
+        np.testing.assert_allclose(out.sum(), (w * t[src]).sum(), rtol=1e-4)
