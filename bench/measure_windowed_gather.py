@@ -27,7 +27,7 @@ t = rng.random(N, dtype=np.float32)
 
 print("bucketing (host, one-time)...", flush=True)
 t0 = time.perf_counter()
-b = bucket_by_window(src, w)
+b = bucket_by_window(src, w, table_size=N)
 print(f"bucketed in {time.perf_counter()-t0:.1f}s, rows={b['n_rows']} "
       f"(pad {(b['n_rows']*1024 - E)/E*100:.2f}%)", flush=True)
 

@@ -45,7 +45,7 @@ ROW = 1024
 BLOCK_ROWS = 64
 
 
-def bucket_by_window(src: np.ndarray, w: np.ndarray) -> dict:
+def bucket_by_window(src: np.ndarray, w: np.ndarray, table_size: int | None = None) -> dict:
     """Group edges so each 1024-edge vreg-row shares one src window.
 
     Returns arrays shaped for ``gather_windowed`` plus the mapping back
@@ -55,6 +55,10 @@ def bucket_by_window(src: np.ndarray, w: np.ndarray) -> dict:
     weight 0.
     """
     e = src.shape[0]
+    if table_size is not None:
+        # Out-of-range indices would be silently clamped by the kernel's
+        # dynamic slice into a wrong (but in-bounds) window — fail here.
+        assert int(src.max()) < table_size, "src index exceeds table size"
     window = src.astype(np.int64) // WINDOW
     order = np.argsort(window, kind="stable").astype(np.int64)
     sorted_win = window[order]
@@ -91,9 +95,15 @@ def bucket_by_window(src: np.ndarray, w: np.ndarray) -> dict:
 
 
 def _kernel(wid_ref, t_ref, local_ref, w_ref, out_ref):
-    """One grid step: BLOCK_ROWS vreg-rows of 1024 edges each."""
+    """One grid step: BLOCK_ROWS vreg-rows of 1024 edges each.
+
+    ``wid_ref`` is the scalar-prefetch ref (SMEM) of the FULL wid
+    array — dynamic-slice starts must come from scalar memory, not a
+    VMEM vector load, to lower on Mosaic."""
+    blk = pl.program_id(0)
     for v in range(BLOCK_ROWS):
-        win = t_ref[pl.ds(wid_ref[v] * 8, 8), :]  # (8,128) window slice
+        wid = wid_ref[blk * BLOCK_ROWS + v]
+        win = t_ref[pl.ds(wid * 8, 8), :]  # (8,128) window slice
         lidx = local_ref[pl.ds(v * 8, 8), :]
         sub = lidx // 128
         lane = lidx % 128
@@ -124,16 +134,21 @@ def gather_windowed(
     )
     t2d = table.reshape(-1, 128)
     n_blocks = n_rows // BLOCK_ROWS
-    return pl.pallas_call(
-        _kernel,
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(n_blocks,),
         in_specs=[
-            pl.BlockSpec((BLOCK_ROWS,), lambda i: (i,)),
-            pl.BlockSpec(t2d.shape, lambda i: (0, 0)),
-            pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i: (i, 0)),
-            pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i: (i, 0)),
+            pl.BlockSpec(t2d.shape, lambda i, wid_ref: (0, 0)),
+            pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i, wid_ref: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i, wid_ref: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i, wid_ref: (i, 0)),
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n_rows * 8, 128), jnp.float32),
         interpret=interpret,
     )(wid, t2d, local, weight)

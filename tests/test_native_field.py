@@ -1,0 +1,65 @@
+"""Adversarial-value tests for the native field engines: the IFMA
+radix-52 lazy-reduction paths (native/zk_ifma.cpp) and the scalar CIOS
+path must agree with exact Python arithmetic at the field boundaries
+(0, 1, p-1, p-2, single-bit limbs, 2^52-boundary patterns), not just on
+random values.
+"""
+
+import random
+
+from protocol_tpu.crypto.field import MODULUS as R
+from protocol_tpu.zk import native
+from protocol_tpu.zk.plonk import omega, _py_ntt
+
+EDGE = [
+    0,
+    1,
+    2,
+    R - 1,
+    R - 2,
+    (1 << 52) - 1,
+    1 << 52,
+    (1 << 104) - 1,
+    (1 << 208) + ((1 << 52) - 1),
+    (1 << 253) + 1,
+    R >> 1,
+]
+
+
+class TestFieldBoundaries:
+    def test_vec_mul_edge_values(self):
+        pairs = [(a, b) for a in EDGE for b in EDGE]
+        a = [p[0] for p in pairs]
+        b = [p[1] for p in pairs]
+        # Pad to a multiple of 8 so the IFMA path covers every pair.
+        while len(a) % 8:
+            a.append(3)
+            b.append(5)
+        got = native.vec_mul(a, b)
+        assert got == [(x * y) % R for x, y in zip(a, b)]
+
+    def test_ntt_edge_coefficients(self):
+        random.seed(7)
+        k = 5
+        n = 1 << k
+        vals = (EDGE * ((n // len(EDGE)) + 1))[:n]
+        w = omega(k)
+        got = native.ntt(list(vals), w, inverse=False)
+        assert got == _py_ntt(list(vals), w, False)
+        back = native.ntt(list(got), pow(w, R - 2, R), inverse=True)
+        assert back == vals
+
+    def test_scale_add_edge_values(self):
+        from protocol_tpu.utils.limbs import from_limbs, to_limbs
+        import numpy as np
+
+        lib = native._load()
+        for s in (0, 1, R - 1, (1 << 52), R >> 1):
+            acc_vals = (EDGE * 2)[:16]
+            p_vals = list(reversed((EDGE * 2)[:16]))
+            acc = to_limbs(acc_vals)
+            pl_ = to_limbs(p_vals)
+            sl = to_limbs([s])
+            lib.zk_scale_add(native._ptr(acc), native._ptr(pl_), native._ptr(sl), 16)
+            got = from_limbs(acc)
+            assert got == [(a + s * p) % R for a, p in zip(acc_vals, p_vals)]

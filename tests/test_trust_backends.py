@@ -227,7 +227,7 @@ class TestWindowedGather:
         w = rng.random(e, dtype=np.float32)
         t = rng.random(n, dtype=np.float32)
 
-        b = bucket_by_window(src, w)
+        b = bucket_by_window(src, w, table_size=n)
         out = np.asarray(
             gather_windowed(
                 jnp.asarray(b["wid"]),
