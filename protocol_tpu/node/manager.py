@@ -329,3 +329,44 @@ class Manager:
         if not self.cached_proofs:
             raise EigenError.proof_not_found()
         return self.cached_proofs[max(self.cached_proofs, key=lambda e: e.number)]
+
+    def aggregate_proofs(self, epochs: list[Epoch]):
+        """Batch-verify cached epoch SNARKs with one pairing check
+        (zk.aggregator): fold every requested epoch's proof into a KZG
+        accumulator and finalize it.  Returns ``(ok, accumulator)``.
+
+        The working half of the reference's unfinished aggregator
+        surface (verifier/aggregator.rs) made node-reachable; requires
+        the PLONK prover (commitment proofs have no pairing structure).
+        """
+        from ..zk.aggregator import Snark, accumulate, finalize
+
+        from .errors import EigenErrorCode
+
+        # Cheap validation first: the config string and the proof cache
+        # — never trigger a lazy keygen (or wait on the boot warm-up)
+        # for a request that would fail anyway.
+        if self.config.prover != "plonk":
+            raise EigenError(
+                EigenErrorCode.VERIFICATION_ERROR,
+                "aggregation requires the plonk prover",
+            )
+        proofs = [self.get_proof(epoch) for epoch in epochs]
+        if self._prover is None:
+            raise EigenError(
+                EigenErrorCode.PROVING_ERROR, "prover still warming up"
+            )
+        prover = self.prover
+        snarks = [
+            Snark(
+                vk=prover.vk,
+                instances=proof.pub_ins,
+                proof=proof.proof,
+                transcript=prover.TRANSCRIPT,
+            )
+            for proof in proofs
+        ]
+        acc = accumulate(snarks)
+        if acc is None:
+            return False, None
+        return finalize(acc, prover.vk), acc

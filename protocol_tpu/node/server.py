@@ -55,6 +55,33 @@ def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
             log.info("score query failed: %s", e)
             return BAD_REQUEST, "InvalidQuery"
         return 200, proof.to_raw(backend=_backend_tag(manager)).to_json()
+    if method == "GET" and path.split("?", 1)[0] == "/aggregate":
+        # /aggregate?epochs=3,7 — one-pairing batch verification of
+        # cached epoch SNARKs (the aggregator surface the reference
+        # never finished wiring).
+        from urllib.parse import parse_qs, urlsplit
+
+        from .epoch import Epoch
+
+        try:
+            qs = parse_qs(urlsplit(path).query)
+            epochs = [
+                Epoch(int(x))
+                for x in qs.get("epochs", [""])[0].split(",")
+                if x != ""
+            ]
+            if not epochs:
+                return BAD_REQUEST, "InvalidQuery"
+            ok, acc = manager.aggregate_proofs(epochs)
+        except (EigenError, ValueError) as e:
+            log.info("aggregate query failed: %s", e)
+            return BAD_REQUEST, "InvalidQuery"
+        body = {
+            "ok": bool(ok),
+            "epochs": [e.number for e in epochs],
+            "accumulator": acc.to_bytes().hex() if acc is not None else None,
+        }
+        return 200, json.dumps(body)
     if method == "GET" and path == "/status":
         status = {
             "attestations": len(manager.attestations),
@@ -104,7 +131,16 @@ class Node:
                             return
 
                 await asyncio.wait_for(drain_headers(), timeout=10)
-                status, body = handle_request(parts[0], parts[1], self.manager)
+                if parts[1].split("?", 1)[0] == "/aggregate":
+                    # Aggregation runs verify_deferred per member plus a
+                    # pairing — seconds of crypto that must not stall the
+                    # event loop (reference stance: heavy work off-loop,
+                    # like _epoch_tick).
+                    status, body = await asyncio.get_running_loop().run_in_executor(
+                        None, handle_request, parts[0], parts[1], self.manager
+                    )
+                else:
+                    status, body = handle_request(parts[0], parts[1], self.manager)
             payload = body.encode()
             writer.write(
                 (

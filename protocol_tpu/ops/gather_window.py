@@ -55,10 +55,15 @@ def bucket_by_window(src: np.ndarray, w: np.ndarray, table_size: int | None = No
     weight 0.
     """
     e = src.shape[0]
-    if table_size is not None:
-        # Out-of-range indices would be silently clamped by the kernel's
-        # dynamic slice into a wrong (but in-bounds) window — fail here.
-        assert int(src.max()) < table_size, "src index exceeds table size"
+    if e == 0:
+        raise ValueError("no edges to bucket")
+    if table_size is not None and (
+        int(src.min()) < 0 or int(src.max()) >= table_size
+    ):
+        # Out-of-range (or negative) indices would be silently clamped
+        # by the kernel's dynamic slice into a wrong but in-bounds
+        # window; must survive python -O, so no assert.
+        raise ValueError("src index outside [0, table_size)")
     window = src.astype(np.int64) // WINDOW
     order = np.argsort(window, kind="stable").astype(np.int64)
     sorted_win = window[order]

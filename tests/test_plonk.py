@@ -343,3 +343,77 @@ class TestEpochProof:
         assert not mgr.prover.verify(
             [(proof.pub_ins[0] + 1) % P] + proof.pub_ins[1:], proof.proof
         )
+
+
+class TestAggregationSurface:
+    """Node-reachable proof aggregation (manager.aggregate_proofs +
+    GET /aggregate): the reference left its aggregator unwired; here
+    batch verification is a served feature."""
+
+    def test_commitment_prover_rejects_aggregation(self):
+        from protocol_tpu.node.epoch import Epoch
+        from protocol_tpu.node.errors import EigenError
+        from protocol_tpu.node.manager import Manager, ManagerConfig
+        from protocol_tpu.node.server import handle_request
+
+        mgr = Manager(ManagerConfig(prover="commitment"))
+        mgr.generate_initial_attestations()
+        mgr.calculate_proofs(Epoch(1))
+        with pytest.raises(EigenError):
+            mgr.aggregate_proofs([Epoch(1)])
+        status, _ = handle_request("GET", "/aggregate?epochs=1", mgr)
+        assert status == 400
+
+    def test_aggregate_bad_queries(self):
+        from protocol_tpu.node.manager import Manager, ManagerConfig
+        from protocol_tpu.node.server import handle_request
+
+        mgr = Manager(ManagerConfig(prover="commitment"))
+        assert handle_request("GET", "/aggregate", mgr)[0] == 400
+        assert handle_request("GET", "/aggregate?epochs=", mgr)[0] == 400
+        assert handle_request("GET", "/aggregate?epochs=x", mgr)[0] == 400
+        assert handle_request("GET", "/aggregate?epochs=9", mgr)[0] == 400  # no proof
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PROTOCOL_TPU_SLOW_TESTS"),
+    reason="aggregating two real epoch SNARKs proves twice (~10 s); "
+    "set PROTOCOL_TPU_SLOW_TESTS=1",
+)
+class TestAggregationSurfaceSlow:
+    def test_manager_aggregates_two_epochs(self):
+        import json
+
+        from protocol_tpu.node.bootstrap import FIXED_SET
+        from protocol_tpu.node.epoch import Epoch
+        from protocol_tpu.node.manager import Manager, ManagerConfig
+        from protocol_tpu.node.server import handle_request
+
+        mgr = Manager(
+            ManagerConfig(
+                prover="plonk",
+                num_neighbours=2,
+                num_iter=1,
+                fixed_set=list(FIXED_SET[:2]),
+            )
+        )
+        mgr.generate_initial_attestations()
+        mgr.calculate_proofs(Epoch(3))
+        mgr.calculate_proofs(Epoch(7))
+        ok, acc = mgr.aggregate_proofs([Epoch(3), Epoch(7)])
+        assert ok and acc is not None
+
+        status, body = handle_request("GET", "/aggregate?epochs=3,7", mgr)
+        obj = json.loads(body)
+        assert status == 200 and obj["ok"] and obj["epochs"] == [3, 7]
+        assert len(bytes.fromhex(obj["accumulator"])) == 128
+
+        # A tampered cached proof must fail the batch.
+        proof = mgr.cached_proofs[Epoch(7)]
+        bad = bytearray(proof.proof)
+        bad[11] ^= 1
+        mgr.cached_proofs[Epoch(7)] = type(proof)(
+            pub_ins=proof.pub_ins, proof=bytes(bad)
+        )
+        ok2, _ = mgr.aggregate_proofs([Epoch(3), Epoch(7)])
+        assert not ok2
