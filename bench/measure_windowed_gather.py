@@ -1,6 +1,6 @@
 """Measure the windowed Pallas gather (ops/gather_window.py) against
 the XLA gather at bench scale on the real chip — run when the TPU
-tunnel is up (PERF.md §5 queue).
+tunnel is up (PERF.md §6 queue).
 
 Expected from the primitive measurements (PERF.md §1): ~30 vreg ops per
 1024 edges ⇒ low single-digit ms per 50M-edge pass plus ~600 MB HBM

@@ -25,7 +25,7 @@ this toolchain, and becomes directly usable if a future Mosaic fixes
 cross-vreg `dynamic_gather` (then the bucketing constraint drops).
 
 Correctness is validated in interpret mode on CPU (tests); wall-clock
-on the real chip is queued on TPU availability (PERF.md §5).
+on the real chip is queued on TPU availability (PERF.md §6).
 """
 
 from __future__ import annotations
