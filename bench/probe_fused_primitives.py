@@ -1,0 +1,123 @@
+"""Probe the primitives the fused windowed-SpMV pipeline (PERF.md §7)
+is built from, on the real chip:
+
+1. XLA transpose throughput at the pipeline's shapes:
+   - big bucket transpose (W, W, S) axes (0,1) — 256 B granularity
+   - per-region matrix transposes (R, 64, 1024) <-> (R, 1024, 64) —
+     4 B granularity
+2. A region-table windowed gather: same 8-way select chain as
+   ops/gather_window.py but the VMEM table block is indexed by the
+   leading grid dimension (one 256 KB region per step) instead of one
+   resident 4 MB table.
+"""
+
+import pathlib
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+REPS = 8
+eps = jnp.float32(1e-38)
+
+
+def timed(name, fn, *args):
+    r = np.asarray(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(2):
+        r = np.asarray(fn(*args))
+    dt = (time.perf_counter() - t0) / 2 / REPS
+    print(f"{name}: {dt*1e3:.2f} ms/pass", flush=True)
+    return dt
+
+
+# ---- 1. transposes ----
+W, S = 1024, 64
+x = jnp.asarray(np.random.default_rng(0).random((W, W, S), np.float32))
+
+
+@jax.jit
+def big_transpose(x):
+    def step(_, acc):
+        return (x + acc * eps).transpose(1, 0, 2)[0, 0, 0]
+    return lax.fori_loop(0, REPS, step, jnp.float32(0))
+
+
+y = jnp.asarray(np.random.default_rng(1).random((1024, 64, 1024), np.float32))
+
+
+@jax.jit
+def region_transpose(y):
+    def step(_, acc):
+        return (y + acc * eps).transpose(0, 2, 1)[0, 0, 0]
+    return lax.fori_loop(0, REPS, step, jnp.float32(0))
+
+
+timed("big transpose (1024,1024,64)->(0,1) 268MB", big_transpose, x)
+timed("region transpose (1024,64,1024)->(0,2,1) 268MB", region_transpose, y)
+
+# ---- 2. region-table windowed gather ----
+BLOCK_ROWS = 64  # vreg-rows per region: 64 * 1024 slots = one region
+
+
+def _kernel(wid_ref, t_ref, local_ref, out_ref):
+    blk = pl.program_id(0)
+    for v in range(BLOCK_ROWS):
+        wid = wid_ref[blk * BLOCK_ROWS + v]
+        win = t_ref[pl.ds(wid * 8, 8), :]
+        lidx = local_ref[pl.ds(v * 8, 8), :]
+        sub = lidx // 128
+        lane = lidx % 128
+        acc = jnp.zeros((8, 128), jnp.float32)
+        for k in range(8):
+            rowk = jnp.broadcast_to(win[k : k + 1, :], (8, 128))
+            g = jnp.take_along_axis(rowk, lane, axis=1)
+            acc = jnp.where(sub == k, g, acc)
+        out_ref[pl.ds(v * 8, 8), :] = acc
+
+
+@partial(jax.jit, static_argnames=("n_regions",))
+def gather_region(wid, table, local, *, n_regions):
+    # table: (n_regions*512, 128) f32; each region's slice is its own
+    # (512,128) VMEM block.  local: (n_regions*512, 128) int32 with
+    # window-local indices; wid: per vreg-row window id in [0, 64).
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_regions,),
+        in_specs=[
+            pl.BlockSpec((512, 128), lambda i, wid_ref: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i, wid_ref: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_ROWS * 8, 128), lambda i, wid_ref: (i, 0)),
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_regions * 512, 128), jnp.float32),
+    )(wid, table, local)
+
+
+n_regions = 1024
+rng = np.random.default_rng(2)
+tbl = jnp.asarray(rng.random((n_regions * 512, 128), np.float32))
+# Random window-local permutation structure: each row reads within one
+# random window of its region.
+wid = jnp.asarray(rng.integers(0, 64, n_regions * BLOCK_ROWS).astype(np.int32))
+loc = jnp.asarray(rng.integers(0, 1024, (n_regions * 512, 128)).astype(np.int32))
+
+
+@jax.jit
+def chain_region(wid, tbl, loc):
+    def step(_, acc):
+        return gather_region(wid, tbl + acc * eps, loc, n_regions=n_regions)[0, 0]
+    return lax.fori_loop(0, REPS, step, jnp.float32(0))
+
+
+timed("region-table windowed gather 67M slots", chain_region, wid, tbl, loc)
