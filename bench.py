@@ -2,11 +2,14 @@
 BASELINE.md five-config ladder.
 
 Default mode (what the driver runs) prints ONE JSON line for config 4 —
-the 1M-peer / 50M-edge scale-free convergence on the CSR kernel, 40
-fixed power iterations, wall-clock excluding compile and host->HBM
-transfer.  The reference publishes no numbers (BASELINE.md); the driver
-target is "< 2 s on a v5e-8" and this runs on however many chips are
-visible (one, under the tunnel).
+the 1M-peer / 50M-edge scale-free convergence on the fused windowed
+pipeline (``tpu-windowed``, PERF.md §7), 40 fixed power iterations,
+wall-clock excluding compile, host->HBM transfer, and the one-time
+bucketing plan (reported separately as ``plan_seconds``).  The previous
+headline kernel stays reachable via ``--backend tpu-csr`` to reproduce
+the 17.9 s PERF.md §1 number.  The reference publishes no numbers
+(BASELINE.md); the driver target is "< 2 s on a v5e-8" and this runs on
+however many chips are visible (one, under the tunnel).
 
 ``--ladder`` runs all five BASELINE.md configs and prints one JSON
 report with five entries (plus the same headline line last, so driver
@@ -29,12 +32,13 @@ def _timed(fn):
     return out, time.perf_counter() - t0
 
 
-def headline_entry(iters: int = 40) -> dict:
+def headline_entry(iters: int = 40, backend: str = "tpu-windowed") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from protocol_tpu.models.graphs import scale_free
+    from protocol_tpu.ops.gather_window import build_window_plan, converge_windowed
     from protocol_tpu.ops.sparse import converge_csr
     from protocol_tpu.trust.graph import TrustGraph
 
@@ -47,26 +51,64 @@ def headline_entry(iters: int = 40) -> dict:
     w, dangling = g.row_normalized()
     g = TrustGraph(g.n, g.src, g.dst, w, graph.pre_trusted).sorted_by_dst()
     p = graph.pre_trust_vector()
+    extra: dict = {}
 
-    device_args = (
-        jax.device_put(jnp.asarray(g.src)),
-        jax.device_put(jnp.asarray(g.row_ptr_by_dst())),
-        jax.device_put(jnp.asarray(g.weight)),
-        jax.device_put(jnp.asarray(p)),
-        jax.device_put(jnp.asarray(p)),
-        jax.device_put(jnp.asarray(dangling.astype(np.float32))),
-    )
-    jax.block_until_ready(device_args)
-
-    def run():
-        t, it, resid = converge_csr(
-            *device_args, alpha=jnp.float32(0.1), tol=0.0, max_iter=iters
+    if backend == "tpu-csr":
+        device_args = (
+            jax.device_put(jnp.asarray(g.src)),
+            jax.device_put(jnp.asarray(g.row_ptr_by_dst())),
+            jax.device_put(jnp.asarray(g.weight)),
+            jax.device_put(jnp.asarray(p)),
+            jax.device_put(jnp.asarray(p)),
+            jax.device_put(jnp.asarray(dangling.astype(np.float32))),
         )
-        # Force a host transfer: on the tunneled single-chip platform
-        # block_until_ready can return before the computation drains, so
-        # timing must include materialising the result on the host (the
-        # 4 MB score-vector copy is noise next to the compute).
-        return np.asarray(t)
+        jax.block_until_ready(device_args)
+
+        def run():
+            t, it, resid = converge_csr(
+                *device_args, alpha=jnp.float32(0.1), tol=0.0, max_iter=iters
+            )
+            # Force a host transfer: on the tunneled single-chip
+            # platform block_until_ready can return before the
+            # computation drains, so timing must include materialising
+            # the result on the host (the 4 MB score-vector copy is
+            # noise next to the compute).
+            return np.asarray(t)
+
+    elif backend == "tpu-windowed":
+        # One-time static plan: excluded from the per-iteration metric
+        # (it amortizes across epochs and reboots via the checkpoint
+        # store) but reported so regressions in host bucketing show up.
+        plan, plan_dt = _timed(
+            lambda: build_window_plan(g.src, g.dst, g.weight, n=g.n)
+        )
+        interpret = jax.default_backend() != "tpu"
+        device_args = tuple(jax.device_put(a) for a in plan.device_args()) + (
+            jax.device_put(jnp.asarray(p)),
+            jax.device_put(jnp.asarray(p)),
+            jax.device_put(jnp.asarray(dangling.astype(np.float32))),
+        )
+        jax.block_until_ready(device_args)
+        extra = {
+            "plan_seconds": round(plan_dt, 4),
+            "bridge_segments": plan.n_segments,
+            "bridge_compression": round(plan.compression, 2),
+        }
+
+        def run():
+            t, it, resid = converge_windowed(
+                *device_args,
+                n_rows=plan.n_rows,
+                table_entries=plan.table_entries,
+                alpha=jnp.float32(0.1),
+                tol=0.0,
+                max_iter=iters,
+                interpret=interpret,
+            )
+            return np.asarray(t)
+
+    else:
+        raise ValueError(f"headline backend must be tpu-windowed or tpu-csr, got {backend!r}")
 
     run()  # compile + warm up
     t0 = time.perf_counter()
@@ -75,14 +117,15 @@ def headline_entry(iters: int = 40) -> dict:
     assert abs(scores.sum() - 1.0) < 1e-3
 
     return {
-        "metric": "1M-peer/50M-edge global-trust convergence wall-clock (40 power iters)",
+        "metric": f"1M-peer/50M-edge global-trust convergence wall-clock (40 power iters, {backend})",
         "value": round(elapsed, 4),
         "unit": "seconds",
         "vs_baseline": round(target_seconds / elapsed, 3),
+        **extra,
     }
 
 
-def ladder(scale_div: int = 1, iters: int = 40) -> list[dict]:
+def ladder(scale_div: int = 1, iters: int = 40, backend: str = "tpu-windowed") -> list[dict]:
     """The five BASELINE.md configs.
 
     Configs 1-3 and 5 time one ``backend.converge`` call after a warm-up
@@ -159,17 +202,17 @@ def ladder(scale_div: int = 1, iters: int = 40) -> list[dict]:
         }
     )
 
-    # -- config 4: the headline (1M/50M CSR) ----------------------------
+    # -- config 4: the headline (1M/50M, fused windowed by default) -----
     if scale_div == 1:
-        entries.append({"config": "4-scale-free-1M-csr", **headline_entry()})
+        entries.append({"config": f"4-scale-free-1M-{backend}", **headline_entry(backend=backend)})
     else:
         n4, e4 = 1_000_000 // scale_div, 50_000_000 // scale_div
         g4 = scale_free(n4, e4, seed=7)
-        res4, dt4 = converge_timed("tpu-csr", g4, alpha=0.1, tol=0.0, max_iter=iters)
+        res4, dt4 = converge_timed(backend, g4, alpha=0.1, tol=0.0, max_iter=iters)
         entries.append(
             {
-                "config": "4-scale-free-1M-csr",
-                "metric": f"{n4}-peer/{e4}-edge CSR convergence ({iters} iters)",
+                "config": f"4-scale-free-1M-{backend}",
+                "metric": f"{n4}-peer/{e4}-edge {backend} convergence ({iters} iters)",
                 "value": round(dt4, 4),
                 "unit": "seconds",
                 "power_iters_per_sec": round(iters / dt4, 2),
@@ -214,6 +257,13 @@ def main() -> None:
     ap.add_argument("--ladder", action="store_true", help="run all 5 BASELINE configs")
     ap.add_argument("--scale-div", type=int, default=1, help="divide ladder sizes (CI smoke)")
     ap.add_argument(
+        "--backend",
+        default="tpu-windowed",
+        choices=["tpu-windowed", "tpu-csr"],
+        help="headline (config 4) kernel: the fused windowed pipeline "
+        "(default, PERF.md §7) or the previous CSR/cumsum formulation",
+    )
+    ap.add_argument(
         "--platform",
         default=None,
         help="force a JAX platform (e.g. cpu for smoke runs); the site "
@@ -229,7 +279,7 @@ def main() -> None:
         jax.config.update("jax_platforms", args.platform)
 
     if args.ladder:
-        entries = ladder(scale_div=args.scale_div)
+        entries = ladder(scale_div=args.scale_div, backend=args.backend)
         print(json.dumps({"ladder": entries}, indent=2))
         # Driver-parsable single line, last.
         headline = next(e for e in entries if e["config"].startswith("4-"))
@@ -239,7 +289,7 @@ def main() -> None:
         print(json.dumps(line))
         return
 
-    print(json.dumps(headline_entry()))
+    print(json.dumps(headline_entry(backend=args.backend)))
 
 
 if __name__ == "__main__":

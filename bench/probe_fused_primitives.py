@@ -9,6 +9,21 @@ is built from, on the real chip:
    ops/gather_window.py but the VMEM table block is indexed by the
    leading grid dimension (one 256 KB region per step) instead of one
    resident 4 MB table.
+
+Timing-loop doctrine (PERF.md §1): every measured op must carry a data
+dependence on the loop state or XLA's WhileLoopInvariantCodeMotion
+hoists it and the "benchmark" times dispatch overhead.  For the
+transposes a scalar carry is NOT enough — slicing one element of a
+transpose lets the algebraic simplifier fold the slice *through* the
+transpose and delete the op entirely — so the transpose loops ping-pong
+two full-array carries: each body materializes two transposes whose
+operands are loop state and whose results become loop state, which
+neither LICM nor the simplifier can remove.  Reported numbers divide by
+the two transposes per iteration (the chained adds ride along, so these
+are slight over-estimates — upper bounds, like the rest of PERF.md).
+The Pallas gather keeps the scalar-carry pattern: a pallas_call is
+opaque to the simplifier, so feeding the carry through its operand and
+consuming one output element suffices.
 """
 
 import pathlib
@@ -28,40 +43,48 @@ REPS = 8
 eps = jnp.float32(1e-38)
 
 
-def timed(name, fn, *args):
-    r = np.asarray(fn(*args))
+def timed(name, fn, *args, per=REPS):
+    r = np.asarray(jax.tree.leaves(fn(*args))[0])  # compile + warm up
     t0 = time.perf_counter()
     for _ in range(2):
-        r = np.asarray(fn(*args))
-    dt = (time.perf_counter() - t0) / 2 / REPS
+        r = np.asarray(jax.tree.leaves(fn(*args))[0])
+    dt = (time.perf_counter() - t0) / 2 / per
     print(f"{name}: {dt*1e3:.2f} ms/pass", flush=True)
     return dt
+
+
+def transpose_chain(x, perm):
+    """REPS iterations, two dependent full-array transposes each: the
+    ping-pong carries make every transpose's operand and result loop
+    state, so nothing can be hoisted, folded, or dead-code-eliminated.
+    """
+
+    @jax.jit
+    def run(x):
+        xt = jnp.transpose(x, perm)  # loop-invariant; hoisted, unmeasured
+
+        def step(_, carry):
+            a, b = carry  # a: x-shaped, b: transposed-shaped
+            b2 = (x + a * eps).transpose(*perm)
+            a2 = (xt + b * eps).transpose(*perm)
+            return a2, b2
+
+        z = jnp.zeros_like(x)
+        a, b = lax.fori_loop(0, REPS, step, (z, jnp.transpose(z, perm)))
+        return a[0, 0, 0] + b[0, 0, 0]
+
+    return run
 
 
 # ---- 1. transposes ----
 W, S = 1024, 64
 x = jnp.asarray(np.random.default_rng(0).random((W, W, S), np.float32))
-
-
-@jax.jit
-def big_transpose(x):
-    def step(_, acc):
-        return (x + acc * eps).transpose(1, 0, 2)[0, 0, 0]
-    return lax.fori_loop(0, REPS, step, jnp.float32(0))
-
-
 y = jnp.asarray(np.random.default_rng(1).random((1024, 64, 1024), np.float32))
 
-
-@jax.jit
-def region_transpose(y):
-    def step(_, acc):
-        return (y + acc * eps).transpose(0, 2, 1)[0, 0, 0]
-    return lax.fori_loop(0, REPS, step, jnp.float32(0))
-
-
-timed("big transpose (1024,1024,64)->(0,1) 268MB", big_transpose, x)
-timed("region transpose (1024,64,1024)->(0,2,1) 268MB", region_transpose, y)
+# (1, 0, 2) and (0, 2, 1) are involutions, so the ping-pong carries keep
+# one static shape.  2 transposes per iteration -> per=2*REPS.
+timed("big transpose (1024,1024,64)->(1,0,2) 268MB", transpose_chain(x, (1, 0, 2)), x, per=2 * REPS)
+timed("region transpose (1024,64,1024)->(0,2,1) 268MB", transpose_chain(y, (0, 2, 1)), y, per=2 * REPS)
 
 # ---- 2. region-table windowed gather ----
 BLOCK_ROWS = 64  # vreg-rows per region: 64 * 1024 slots = one region
@@ -115,8 +138,12 @@ loc = jnp.asarray(rng.integers(0, 1024, (n_regions * 512, 128)).astype(np.int32)
 
 @jax.jit
 def chain_region(wid, tbl, loc):
+    # The carry perturbs the table operand; the pallas_call is opaque to
+    # the simplifier, so consuming one output element keeps the whole
+    # kernel live while LICM sees a loop-varying operand.
     def step(_, acc):
         return gather_region(wid, tbl + acc * eps, loc, n_regions=n_regions)[0, 0]
+
     return lax.fori_loop(0, REPS, step, jnp.float32(0))
 
 

@@ -9,7 +9,11 @@ score vector and can serve scores immediately after restart while the
 replay catches up.
 
 Format: ``<dir>/epoch_<N>.npz`` (numpy arrays) + ``manifest.json``
-pointing at the latest; writes are atomic (tmp + rename).
+pointing at the latest; writes are atomic (tmp + rename).  When the
+node converges on the ``tpu-windowed`` backend, the one-time bucketing
+plan (ops.gather_window.WindowPlan — the expensive host-side layout)
+rides along as ``epoch_<N>.plan.npz`` so a reboot revalidates it by
+fingerprint instead of rebuilding it.
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ from pathlib import Path
 
 import numpy as np
 
+from ..ops.gather_window import WindowPlan
 from ..trust.graph import TrustGraph
 from .epoch import Epoch
 
@@ -32,6 +37,7 @@ class Snapshot:
     graph: TrustGraph
     scores: np.ndarray | None
     proof_json: str | None = None
+    plan: WindowPlan | None = None
 
 
 class CheckpointStore:
@@ -55,7 +61,14 @@ class CheckpointStore:
                 os.unlink(tmp)
             raise
 
-    def save(self, epoch: Epoch, graph: TrustGraph, scores=None, proof_json: str | None = None) -> Path:
+    def save(
+        self,
+        epoch: Epoch,
+        graph: TrustGraph,
+        scores=None,
+        proof_json: str | None = None,
+        plan: WindowPlan | None = None,
+    ) -> Path:
         path = self._path(epoch)
         payload = {
             "n": np.int64(graph.n),
@@ -69,6 +82,14 @@ class CheckpointStore:
             payload["scores"] = np.asarray(scores, dtype=np.float64)
 
         self._atomic_write(path, lambda f: np.savez_compressed(f, **payload), "wb")
+        if plan is not None:
+            # Uncompressed: the plan is int/float index arrays that
+            # barely compress, and the save sits on the epoch tick.
+            self._atomic_write(
+                self.dir / f"epoch_{epoch.number}.plan.npz",
+                lambda f: np.savez(f, **plan.to_arrays(core_only=True)),
+                "wb",
+            )
         if proof_json is not None:
             self._atomic_write(
                 self.dir / f"epoch_{epoch.number}.proof.json",
@@ -88,11 +109,15 @@ class CheckpointStore:
         for number in snaps[: -self.keep]:
             self._path(Epoch(number)).unlink(missing_ok=True)
             (self.dir / f"epoch_{number}.proof.json").unlink(missing_ok=True)
+            (self.dir / f"epoch_{number}.plan.npz").unlink(missing_ok=True)
 
     def epochs(self) -> list[int]:
+        # Sidecar files (epoch_N.plan.npz) share the prefix and glob;
+        # only bare epoch_N.npz snapshots define the epoch set.
         return [
             int(p.stem.removeprefix("epoch_"))
             for p in self.dir.glob("epoch_*.npz")
+            if p.stem.removeprefix("epoch_").isdigit()
         ]
 
     def load(self, epoch: Epoch) -> Snapshot:
@@ -107,7 +132,14 @@ class CheckpointStore:
             scores = np.array(z["scores"]) if "scores" in z else None
         proof_path = self.dir / f"epoch_{epoch.number}.proof.json"
         proof_json = proof_path.read_text() if proof_path.exists() else None
-        return Snapshot(epoch=epoch, graph=graph, scores=scores, proof_json=proof_json)
+        plan_path = self.dir / f"epoch_{epoch.number}.plan.npz"
+        plan = None
+        if plan_path.exists():
+            with np.load(plan_path) as pz:
+                plan = WindowPlan.from_arrays(pz)
+        return Snapshot(
+            epoch=epoch, graph=graph, scores=scores, proof_json=proof_json, plan=plan
+        )
 
     def load_latest(self) -> Snapshot | None:
         manifest = self.dir / "manifest.json"

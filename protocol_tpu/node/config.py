@@ -18,6 +18,9 @@ class ProtocolConfig:
     ethereum_node_url: str = "http://localhost:8545"
     as_contract_address: str = "0x" + "0" * 40
     # Rebuild-specific (absent from reference configs; defaulted).
+    # Any trust/backend.py ladder rung: native-cpu | tpu-dense |
+    # tpu-sparse | tpu-csr | tpu-windowed | tpu-sharded.  tpu-windowed
+    # additionally persists its bucketing plan with each checkpoint.
     trust_backend: str = "native-cpu"
     event_fixture: str | None = None
     checkpoint_dir: str | None = None

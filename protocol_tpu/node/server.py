@@ -190,7 +190,13 @@ class Node:
             )
             with TELEMETRY.timer("epoch.checkpoint"):
                 CheckpointStore(self.config.checkpoint_dir).save(
-                    epoch, graph, scores, proof_json
+                    epoch,
+                    graph,
+                    scores,
+                    proof_json,
+                    # tpu-windowed only: the one-time bucketing plan, so
+                    # a reboot revalidates instead of rebuilding it.
+                    plan=self.manager.window_plan,
                 )
         TELEMETRY.count("epochs")
 
@@ -254,11 +260,13 @@ class Node:
             proof = ProofRaw.from_json(snapshot.proof_json).to_proof()
             self.manager.cached_proofs[snapshot.epoch] = proof
         self.manager.last_graph = snapshot.graph
+        self.manager.window_plan = snapshot.plan
         log.info(
-            "restored checkpoint: epoch %s, %d peers%s",
+            "restored checkpoint: epoch %s, %d peers%s%s",
             snapshot.epoch,
             snapshot.graph.n,
             ", proof available" if snapshot.proof_json else "",
+            ", windowed plan restored" if snapshot.plan is not None else "",
         )
 
     async def start(self) -> None:

@@ -1,4 +1,13 @@
-"""jit'd JAX kernels for trust convergence: dense, set-semantics, sparse."""
+"""jit'd JAX kernels for trust convergence: dense, set-semantics, sparse,
+and the fused windowed (fixed-slot) pipeline."""
 
 from .dense import converge_dense, filter_and_normalize, set_converge_dense  # noqa: F401
-from .sparse import converge_sparse, power_step_coo  # noqa: F401
+from .gather_window import (  # noqa: F401
+    WindowPlan,
+    bucket_by_window,
+    build_window_plan,
+    converge_windowed,
+    gather_windowed,
+    power_step_windowed,
+)
+from .sparse import converge_csr, converge_sparse, power_step_coo, power_step_csr  # noqa: F401

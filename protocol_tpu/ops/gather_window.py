@@ -1,41 +1,63 @@
-"""Windowed Pallas gather: score-table lookups from VMEM using only the
-Mosaic primitives that compile on this toolchain.
+"""Windowed Pallas gather and the fused fixed-slot pipeline built on it.
 
 PERF.md §1 establishes that XLA's TPU gather runs at ~7 cycles/element
 (386 ms per 50M-edge iteration, 86 % of the bench step) and that
 Mosaic's general cross-vreg dynamic gather crashes the compiler.  What
 *does* compile: dynamic sublane slicing of a VMEM ref, range-8 sublane
 `take_along_axis`, range-128 lane `take_along_axis`, broadcasts, and
-selects.  This kernel composes exactly those into a windowed gather:
+selects.  This module composes exactly those into a windowed gather and
+— new in PERF.md §7 — a full power step that consumes it:
 
 - Host side (`bucket_by_window`, one-time per graph): edges are
   grouped so every 1024-edge vreg-row shares one 1024-entry window of
   the table (`src // 1024`); rows are padded with window-local zeros
-  and a weight mask.
-- Kernel side (`gather_windowed`): the 4 MB score table lives in VMEM
-  as (8192, 128); per vreg-row the kernel dynamic-slices the (8, 128)
+  and a weight mask.  The loop-free formulation (argsort + cumulative
+  counts) buckets 50M edges in seconds, not the ~34 s of the original
+  per-window Python loop (PERF.md §6).
+- Kernel side (`gather_windowed`): the ≤4 MB score table lives in VMEM
+  as (rows, 128); per vreg-row the kernel dynamic-slices the (8, 128)
   window and resolves the 1024 local indices with an 8-way
   broadcast/lane-gather/select chain (~30 vreg ops per 1024 edges).
+- Bridge side (`power_step_windowed`, PERF.md §7): the kernel output is
+  in *bucket order*, not the dst order the rowsum needs.  Bridging
+  per-edge would itself be an O(E) random gather (the circularity that
+  stalled PERF.md §1).  Instead `bucket_by_window` additionally sorts
+  each window's edges by dst and emits a static two-level reduction
+  plan: per-(vreg-row, dst) runs reduce locally out of a row-local
+  compensated prefix sum (two static boundary gathers over the
+  ``n_segments`` run boundaries), and only those partials — not the E
+  edge contributions — cross the bucket→dst boundary through the
+  existing ``rowsum_sorted`` machinery via a host-precomputed
+  dst-sorted layout.  Per iteration the device touches random memory
+  only at segment boundaries: O(n_segments + N) with
+  ``n_segments <= min(E, n_windows · N)``, which the hub-heavy bench
+  graph compresses far below E (the plan records the measured ratio).
 
-The output is in *bucket order*, not dst order — PERF.md §1 documents
-why that prevents fusing this kernel into the full CSR pipeline (the
-rowsum needs dst order and the bridging permutation is itself a random
-gather).  The kernel stands as the best-achievable custom gather on
-this toolchain, and becomes directly usable if a future Mosaic fixes
-cross-vreg `dynamic_gather` (then the bucketing constraint drops).
-
-Correctness is validated in interpret mode on CPU (tests); wall-clock
-on the real chip is queued on TPU availability (PERF.md §6).
+Correctness is validated in interpret mode on CPU (tests); per-op
+wall-clock on the real chip is in PERF.md §6 and the fused-pipeline
+projection in §7.
 """
 
 from __future__ import annotations
 
+import hashlib
+from dataclasses import dataclass
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+
+from .sparse import _ds_cumsum_axis1, rowsum_sorted, run_power_iteration
+
+try:
+    # The C two-pass kernel underneath scipy's COO→CSR conversion; the
+    # coo_matrix wrapper around it re-validates indices with two extra
+    # O(E) passes (~0.5 s at 50M edges on the bench host).
+    from scipy.sparse import _sparsetools as _scipy_sparsetools
+except ImportError:  # pragma: no cover - bench/prod images carry scipy
+    _scipy_sparsetools = None
 
 #: Window width in table entries: one (8, 128) VMEM tile.
 WINDOW = 1024
@@ -45,7 +67,73 @@ ROW = 1024
 BLOCK_ROWS = 64
 
 
-def bucket_by_window(src: np.ndarray, w: np.ndarray, table_size: int | None = None) -> dict:
+#: log2(WINDOW): window ids and window-local indices are shifts/masks.
+_WIN_BITS = 10
+
+
+def _counting_sort(
+    key: np.ndarray, n_keys: int, payload: np.ndarray | None = None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray | None]:
+    """Stable counting sort by a small-domain non-negative integer key:
+    returns ``(order, counts, sorted_payload)`` where ``order`` is the
+    ``argsort(key, kind="stable")`` permutation and ``counts`` the
+    per-key histogram.
+
+    numpy's stable argsort costs ~8-10 s at 50M elements on the bench
+    host — most of the old 34 s bucketing loop's replacement budget.
+    scipy's COO→CSR conversion is the same counting sort as a two-pass
+    C loop, O(E + n_keys): rows are the keys, columns the positions, so
+    the CSR column indices come out key-grouped in stable position
+    order, and the CSR data array carries ``payload`` through the sort
+    without a separate O(E) random gather.  Falls back to numpy where
+    scipy is missing.
+    """
+    e = key.shape[0]
+    coo_tocsr = getattr(_scipy_sparsetools, "coo_tocsr", None)
+    if coo_tocsr is None or e >= 2**31 or n_keys >= 2**31:  # pragma: no cover
+        order = np.argsort(key, kind="stable")
+        counts = np.bincount(key, minlength=n_keys)
+        return order, counts, None if payload is None else payload[order]
+    data = (
+        np.ascontiguousarray(payload)
+        if payload is not None
+        else np.empty(e, np.int8)
+    )
+    key = np.ascontiguousarray(key, dtype=np.int32)
+    indptr = np.empty(n_keys + 1, np.int32)
+    order = np.empty(e, np.int32)
+    sorted_data = np.empty(e, data.dtype)
+    coo_tocsr(
+        n_keys, e, e, key, np.arange(e, dtype=np.int32), data,
+        indptr, order, sorted_data,
+    )
+    return order, np.diff(indptr), sorted_data if payload is not None else None
+
+
+def _pack_lanes(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Pack two equal-length 4-byte arrays into one int64 array
+    (bit-preserving), so one counting-sort pass carries both payloads
+    at once instead of paying two O(E) permutations."""
+    lanes = np.empty((a.shape[0], 2), np.int32)
+    lanes[:, 0] = a if a.dtype == np.int32 else a.view(np.int32)
+    lanes[:, 1] = b if b.dtype == np.int32 else b.view(np.int32)
+    return lanes.view(np.int64)[:, 0]
+
+
+def _unpack_lanes(packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Bit-exact int32 lane views of a ``_pack_lanes`` array."""
+    v = packed.view(np.int32).reshape(-1, 2)
+    return v[:, 0], v[:, 1]
+
+
+def bucket_by_window(
+    src: np.ndarray,
+    w: np.ndarray,
+    table_size: int | None = None,
+    *,
+    dst: np.ndarray | None = None,
+    n_dst: int | None = None,
+) -> dict:
     """Group edges so each 1024-edge vreg-row shares one src window.
 
     Returns arrays shaped for ``gather_windowed`` plus the mapping back
@@ -53,43 +141,104 @@ def bucket_by_window(src: np.ndarray, w: np.ndarray, table_size: int | None = No
     ``contrib_input[order[k]] = contrib_bucketed[out_pos[k]]`` —
     ``out_pos`` accounts for the per-window padding, which carries
     weight 0.
+
+    With ``dst`` (and ``n_dst``) given, edges are additionally sorted by
+    destination *within* each window and the dict gains the static
+    bucket→dst reduction plan (PERF.md §7): ``seg_start``/``seg_end``
+    flat slot bounds of every per-(vreg-row, dst) run, already permuted
+    into dst order, and ``dst_ptr`` delimiting each destination's runs —
+    everything ``power_step_windowed`` needs to reduce bucket-order
+    contributions to a dense Cᵀt with no O(E) random access.
+
+    Fully vectorized: stable counting sorts (scipy COO→CSR, O(E)) plus
+    cumulative-count placement — the previous per-window Python loop
+    was ~34 s at 50M edges; this formulation is bounded by the sort's
+    payload movement (<5 s measured, PERF.md §7).
     """
     e = src.shape[0]
     if e == 0:
         raise ValueError("no edges to bucket")
-    if table_size is not None and (
-        int(src.min()) < 0 or int(src.max()) >= table_size
-    ):
+    src = np.asarray(src, dtype=np.int32)
+    w = np.asarray(w, dtype=np.float32)
+    smin, smax = int(src.min()), int(src.max())
+    if smin < 0 or (table_size is not None and smax >= table_size):
         # Out-of-range (or negative) indices would be silently clamped
         # by the kernel's dynamic slice into a wrong but in-bounds
         # window; must survive python -O, so no assert.
         raise ValueError("src index outside [0, table_size)")
-    window = src.astype(np.int64) // WINDOW
-    order = np.argsort(window, kind="stable").astype(np.int64)
-    sorted_win = window[order]
-    # Rows per window bucket, each padded to a full vreg-row.
-    uniq, counts = np.unique(sorted_win, return_counts=True)
+    n_src = table_size if table_size is not None else smax + 1
+    n_windows = -(-n_src // WINDOW)
+
+    if dst is None:
+        o1, s1, w1, d1 = None, src, w, None
+    else:
+        if n_dst is None:
+            raise ValueError("n_dst is required when dst is given")
+        if int(dst.min()) < 0 or int(dst.max()) >= n_dst:
+            raise ValueError("dst index outside [0, n_dst)")
+        dst = np.asarray(dst, dtype=np.int32)
+        # Within-window dst order = one stable counting sort by window
+        # over a dst-sorted edge sequence.  The node/bench graphs arrive
+        # dst-sorted (``TrustGraph.sorted_by_dst``), so the usual cost
+        # is a single O(E) pass; unsorted input pays one extra
+        # dst-keyed pass (LSD radix), with (src, w) riding the payload
+        # lanes so no separate O(E) random gathers are needed.
+        if np.any(dst[1:] < dst[:-1]):
+            o1, dst_counts, packed = _counting_sort(
+                dst, n_dst, payload=_pack_lanes(src, w)
+            )
+            if packed is None:  # pragma: no cover - numpy fallback
+                s1, w1 = src[o1], w[o1]
+            else:
+                s1, w1raw = _unpack_lanes(packed)
+                w1 = w1raw.view(np.float32)
+            d1 = np.repeat(np.arange(n_dst, dtype=np.int32), dst_counts)
+        else:
+            o1, s1, w1, d1 = None, src, w, dst
+    # The one window-keyed counting sort.  The small key domain
+    # (E/1024 windows) matters: the placement pass advances one write
+    # pointer per key, so with ~1000 keys the writes stream (measured
+    # ~6× faster than a src-keyed pass whose 1M pointers scatter every
+    # write to a cold cache line).  (local, w) ride the payload lanes;
+    # ``order`` is the CSR column indices, for free.
+    window = s1 >> _WIN_BITS
+    order, counts, data = _counting_sort(
+        window, n_windows, payload=_pack_lanes(s1 & (WINDOW - 1), w1)
+    )
+    if data is None:  # pragma: no cover - numpy fallback
+        local_sorted = (s1 & (WINDOW - 1))[order]
+        w_sorted = w1[order]
+    else:
+        local_sorted, wraw = _unpack_lanes(data)
+        w_sorted = wraw.view(np.float32)
+    ds = d1[order] if d1 is not None else None
+    if o1 is not None:
+        order = o1[order]
+
+    # Rows per window, each padded to a full vreg-row; grid padded to
+    # block granularity.  Windows with no edges contribute zero rows.
     rows_per = -(-counts // ROW)
-    total_rows = int(rows_per.sum())
-    # Pad to the grid's block granularity.
-    total_rows = -(-total_rows // BLOCK_ROWS) * BLOCK_ROWS
+    row_offset = np.concatenate([[0], np.cumsum(rows_per)]).astype(np.int64)
+    n_data_rows = int(row_offset[-1])
+    total_rows = -(-n_data_rows // BLOCK_ROWS) * BLOCK_ROWS
+    # Flat slot of each window-sorted edge: consecutive within its
+    # window, starting at the window's first (fresh) vreg-row.  One
+    # repeat over the per-window pad shift; the scatter below is
+    # monotonic (sorted destinations), so it streams.  int32 throughout:
+    # slot count < 2³¹ is already implied by the int32 edge arrays, and
+    # the narrower lanes halve this pass's memory traffic (measured 6×
+    # on the bench host).
+    win_off = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    out_pos = np.repeat(
+        (row_offset[:-1] * ROW - win_off).astype(np.int32), counts
+    ) + np.arange(e, dtype=np.int32)
     local = np.zeros(total_rows * ROW, np.int32)
     weight = np.zeros(total_rows * ROW, np.float32)
-    out_pos = np.zeros(e, np.int64)  # bucketed position of input edge order[k]
+    local[out_pos] = local_sorted
+    weight[out_pos] = w_sorted
     wid = np.zeros(total_rows, np.int32)
-    row = 0
-    off = 0
-    for u, c in zip(uniq, counts):
-        idx = order[off : off + c]
-        base = row * ROW
-        local[base : base + c] = (src[idx] % WINDOW).astype(np.int32)
-        weight[base : base + c] = w[idx]
-        out_pos[off : off + c] = base + np.arange(c)
-        nrows = -(-c // ROW)
-        wid[row : row + nrows] = u
-        row += nrows
-        off += c
-    return {
+    wid[:n_data_rows] = np.repeat(np.arange(n_windows, dtype=np.int32), rows_per)
+    result = {
         "local": local.reshape(total_rows * 8, 128),
         "weight": weight.reshape(total_rows * 8, 128),
         "wid": wid,
@@ -97,6 +246,44 @@ def bucket_by_window(src: np.ndarray, w: np.ndarray, table_size: int | None = No
         "out_pos": out_pos,
         "n_rows": total_rows,
     }
+    if ds is None:
+        return result
+
+    # -- static two-level reduction plan (PERF.md §7) -------------------
+    # Segments are maximal same-dst slot runs within one vreg-row: edges
+    # are dst-sorted inside each window and packed into consecutive
+    # slots, so a run breaks only at a dst change or a row boundary (a
+    # window change always starts a fresh row, so it needs no term).
+    brk = np.empty(e, bool)
+    brk[0] = True
+    brk[1:] = (ds[1:] != ds[:-1]) | (out_pos[1:] & (ROW - 1) == 0)
+    end_mask = np.empty(e, bool)
+    end_mask[-1] = True
+    end_mask[:-1] = brk[1:]
+    seg_dst = ds[brk]
+    # Host-side dst sort of the segment table folds the bucket→dst
+    # permutation into the (static) boundary-gather indices, so the
+    # device never permutes the partials separately; start/end bounds
+    # ride the payload lanes of one S-sized counting sort.
+    sperm, seg_counts, seg_packed = _counting_sort(
+        seg_dst,
+        n_dst,
+        payload=_pack_lanes(out_pos[brk], out_pos[end_mask]),
+    )
+    if seg_packed is None:  # pragma: no cover - numpy fallback
+        seg_start = out_pos[brk].astype(np.int32)[sperm]
+        seg_end = out_pos[end_mask].astype(np.int32)[sperm]
+    else:
+        seg_start, seg_end = _unpack_lanes(seg_packed)
+    dst_ptr = np.zeros(n_dst + 1, np.int64)
+    np.cumsum(seg_counts, out=dst_ptr[1:])
+    result.update(
+        seg_start=np.ascontiguousarray(seg_start),
+        seg_end=np.ascontiguousarray(seg_end),
+        dst_ptr=dst_ptr.astype(np.int32),
+        n_segments=int(seg_dst.shape[0]),
+    )
+    return result
 
 
 def _kernel(wid_ref, t_ref, local_ref, w_ref, out_ref):
@@ -131,7 +318,7 @@ def gather_windowed(
     interpret: bool = False,
 ) -> jax.Array:
     """``out[r, j] = weight[r, j] * table[wid[r//8]*1024 + local[r, j]]``
-    with the table resident in VMEM as (8192, 128)."""
+    with the table resident in VMEM as (rows, 128)."""
     assert table.size % WINDOW == 0
     assert n_rows % BLOCK_ROWS == 0, (
         f"n_rows must be a multiple of {BLOCK_ROWS} (bucket_by_window pads "
@@ -157,3 +344,205 @@ def gather_windowed(
         out_shape=jax.ShapeDtypeStruct((n_rows * 8, 128), jnp.float32),
         interpret=interpret,
     )(wid, t2d, local, weight)
+
+
+# ---------------------------------------------------------------------------
+# The fused fixed-slot pipeline (PERF.md §7)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class WindowPlan:
+    """Static per-graph layout for the fused windowed power step.
+
+    Built once on the host (``build_window_plan``), reused every
+    iteration and across epochs while the graph fingerprint matches;
+    persisted by ``node/checkpoint.py`` so a node reboot doesn't re-pay
+    construction.  ``order``/``out_pos`` map bucket slots back to input
+    edges — needed only by tests and diagnostics, so checkpoints omit
+    them (``to_arrays(core_only=True)``).
+    """
+
+    n: int  # peers (dense output length)
+    n_rows: int  # padded vreg-rows
+    table_entries: int  # score table padded to a WINDOW multiple
+    n_segments: int  # per-(row, dst) runs crossing the bridge
+    wid: np.ndarray  # (n_rows,) int32 window id per vreg-row
+    local: np.ndarray  # (n_rows*8, 128) int32 window-local indices
+    weight: np.ndarray  # (n_rows*8, 128) f32 slot weights (0 = padding)
+    seg_start: np.ndarray  # (S,) int32 first slot of each run, dst-sorted
+    seg_end: np.ndarray  # (S,) int32 last slot of each run, dst-sorted
+    dst_ptr: np.ndarray  # (n+1,) int32 run range per destination
+    fingerprint: str  # graph identity for safe reuse
+    order: np.ndarray | None = None  # (E,) bucket position k ← edge order[k]
+    out_pos: np.ndarray | None = None  # (E,) slot of edge order[k]
+
+    _CORE = ("wid", "local", "weight", "seg_start", "seg_end", "dst_ptr")
+    _META = ("n", "n_rows", "table_entries", "n_segments")
+
+    @property
+    def compression(self) -> float:
+        """Edge contributions per bridge partial (E / n_segments) —
+        how much the two-level reduction shrinks the random-access
+        volume vs a per-edge bucket→dst permutation."""
+        e = int(np.count_nonzero(self.weight)) if self.order is None else len(self.order)
+        return e / max(self.n_segments, 1)
+
+    def device_args(self) -> tuple:
+        """Core arrays as device arrays, in ``converge_windowed`` order."""
+        return tuple(jnp.asarray(getattr(self, k)) for k in self._CORE)
+
+    def to_arrays(self, *, core_only: bool = True) -> dict:
+        """npz-ready mapping (checkpoint format)."""
+        out = {k: np.int64(getattr(self, k)) for k in self._META}
+        out["fingerprint"] = np.bytes_(self.fingerprint.encode())
+        for k in self._CORE:
+            out[k] = getattr(self, k)
+        if not core_only and self.order is not None:
+            out["order"] = self.order
+            out["out_pos"] = self.out_pos
+        return out
+
+    @classmethod
+    def from_arrays(cls, z) -> "WindowPlan":
+        return cls(
+            **{k: int(z[k]) for k in cls._META},
+            **{k: np.asarray(z[k]) for k in cls._CORE},
+            fingerprint=bytes(z["fingerprint"]).decode(),
+            order=np.asarray(z["order"]) if "order" in z else None,
+            out_pos=np.asarray(z["out_pos"]) if "out_pos" in z else None,
+        )
+
+
+def graph_fingerprint(n: int, src: np.ndarray, dst: np.ndarray, w: np.ndarray) -> str:
+    """Cheap identity for plan-reuse validation: exact (n, nnz) plus a
+    sha1 over strided samples of the edge arrays (hashing all 600 MB at
+    bench scale would cost a meaningful fraction of plan construction;
+    a strided digest catches every realistic graph change)."""
+    h = hashlib.sha1()
+    h.update(np.asarray([n, src.shape[0]], np.int64).tobytes())
+    stride = max(1, src.shape[0] // (1 << 20))
+    for a in (src, dst, w):
+        h.update(np.ascontiguousarray(a[::stride]).tobytes())
+    return h.hexdigest()
+
+
+def build_window_plan(
+    src: np.ndarray, dst: np.ndarray, w: np.ndarray, *, n: int
+) -> WindowPlan:
+    """One-time host construction of the fused-pipeline layout for a
+    row-normalized, self-edge-free edge list."""
+    b = bucket_by_window(src, w, table_size=n, dst=dst, n_dst=n)
+    return WindowPlan(
+        n=n,
+        n_rows=b["n_rows"],
+        table_entries=-(-n // WINDOW) * WINDOW,
+        n_segments=b["n_segments"],
+        wid=b["wid"],
+        local=b["local"],
+        weight=b["weight"],
+        seg_start=b["seg_start"],
+        seg_end=b["seg_end"],
+        dst_ptr=b["dst_ptr"],
+        fingerprint=graph_fingerprint(n, src, dst, w),
+        order=b["order"],
+        out_pos=b["out_pos"],
+    )
+
+
+def power_step_windowed(
+    wid: jax.Array,
+    local: jax.Array,
+    weight: jax.Array,
+    seg_start: jax.Array,
+    seg_end: jax.Array,
+    dst_ptr: jax.Array,
+    t: jax.Array,
+    p: jax.Array,
+    dangling: jax.Array,
+    alpha: jax.Array | float,
+    *,
+    n_rows: int,
+    table_entries: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """One damped step of the fused fixed-slot pipeline:
+
+    1. windowed Pallas gather-multiply from the VMEM-resident score
+       table (bucket order — no random access, PERF.md §6: 7.9 ms at
+       50M edges);
+    2. row-local double-single prefix sum over the (n_rows, 1024) slot
+       matrix (sequential vector work, the ``_ds_cumsum`` machinery);
+    3. per-(row, dst) run partials via two static boundary gathers at
+       ``seg_start``/``seg_end`` — the only random access, already in
+       dst order (host-folded permutation), O(n_segments);
+    4. ``rowsum_sorted`` over the dst-sorted partials → dense Cᵀt,
+       then the shared damping + dangling redistribution + L1 renorm.
+    """
+    n = p.shape[0]
+    table = jnp.pad(t, (0, table_entries - n))
+    out = gather_windowed(
+        wid, table, local, weight, n_rows=n_rows, interpret=interpret
+    )
+    hi, lo = _ds_cumsum_axis1(out.reshape(n_rows, ROW))
+    fh, fl = hi.reshape(-1), lo.reshape(-1)
+    # Run sum = inclusive_prefix[end] − inclusive_prefix[start−1], with
+    # the row-leading run reading an exact zero (runs never span rows).
+    first = seg_start % ROW == 0
+    prev = jnp.where(first, 0, seg_start - 1)
+    start_h = jnp.where(first, 0.0, fh[prev])
+    start_l = jnp.where(first, 0.0, fl[prev])
+    # Difference hi/lo lanes separately so the hi cancellation stays
+    # exact (Sterbenz), matching rowsum_sorted's row differencing.
+    partial = (fh[seg_end] - start_h) + (fl[seg_end] - start_l)
+    ct = rowsum_sorted(partial, dst_ptr)
+    dangling_mass = jnp.sum(t * dangling)
+    t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
+    return t_new / jnp.sum(t_new)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_rows", "table_entries", "tol", "max_iter", "interpret"),
+)
+def converge_windowed(
+    wid: jax.Array,
+    local: jax.Array,
+    weight: jax.Array,
+    seg_start: jax.Array,
+    seg_end: jax.Array,
+    dst_ptr: jax.Array,
+    t0: jax.Array,
+    p: jax.Array,
+    dangling: jax.Array,
+    *,
+    n_rows: int,
+    table_entries: int,
+    alpha: jax.Array | float = 0.1,
+    tol: float = 1e-6,
+    max_iter: int = 50,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused-pipeline analog of ``converge_csr`` — same shared
+    ``run_power_iteration`` driver, so early-exit semantics can't drift
+    between formulations."""
+    return run_power_iteration(
+        lambda t: power_step_windowed(
+            wid,
+            local,
+            weight,
+            seg_start,
+            seg_end,
+            dst_ptr,
+            t,
+            p,
+            dangling,
+            alpha,
+            n_rows=n_rows,
+            table_entries=table_entries,
+            interpret=interpret,
+        ),
+        t0,
+        tol=tol,
+        max_iter=max_iter,
+    )

@@ -3,15 +3,23 @@
 Layout (the scaling-book recipe: pick a mesh, annotate shardings, let
 XLA place collectives):
 
-- **edges** (src, dst, w): sharded on the leading axis across the mesh —
+- **edges** (src, w): sharded on the leading axis across the mesh —
   each device owns a contiguous dst-sorted slice, padded with w=0 to
   equal length.  50M edges over 8 chips = 6.25M edges/chip, streamed
   sequentially from HBM.
+- **row_ptr**: per-shard CSR-by-dst pointers into the local edge slice
+  (``(n_shards, n+1)`` sharded on axis 0), precomputed on the host by
+  clipping the global pointer array to each shard's range.  This lets
+  every shard run the same scatter-free ``rowsum_sorted`` cumsum kernel
+  as the single-device ``tpu-csr`` path (PERF.md §1 measured the old
+  per-shard ``segment_sum`` 2.4× slower end-to-end at full scale).
 - **t, p, dangling**: replicated (a 1M-peer f32 vector is 4 MB — cheap
   to replicate, expensive to re-gather per step).
 - per step, inside ``shard_map``: each device computes its partial
-  ``Cᵀt`` by gather-multiply-``segment_sum`` over its edge slice, then a
-  single ``lax.psum`` over ICI produces the full product; damping and L1
+  ``Cᵀt`` by gather-multiply-``rowsum_sorted`` over its edge slice, then
+  a single ``lax.psum`` over ICI produces the full product — boundary
+  destinations whose edge runs straddle a shard cut are partially
+  summed on each side and completed by that same psum; damping and L1
   renorm are elementwise on the replicated result so every device stays
   consistent without further communication.
 
@@ -34,6 +42,11 @@ from jax.sharding import PartitionSpec as P
 from ..trust.graph import TrustGraph
 from .mesh import SHARD_AXIS
 
+try:  # jax >= 0.6 exposes shard_map at the top level...
+    _shard_map = jax.shard_map
+except AttributeError:  # ...older images still carry the experimental path
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 @dataclass
 class ShardedTrustProblem:
@@ -42,16 +55,16 @@ class ShardedTrustProblem:
     mesh: Mesh
     n: int
     src: jax.Array  # (E_pad,) int32, sharded
-    dst: jax.Array  # (E_pad,) int32, sharded
     w: jax.Array  # (E_pad,) f32, sharded, row-normalized
+    row_ptr: jax.Array  # (n_shards, n+1) int32, sharded on axis 0
     p: jax.Array  # (n,) f32, replicated
     dangling: jax.Array  # (n,) f32, replicated
 
     @classmethod
     def build(cls, graph: TrustGraph, mesh: Mesh) -> "ShardedTrustProblem":
         """Host-side assembly: drop self-edges, row-normalize, sort by
-        dst, pad to the mesh size, and place arrays with explicit
-        shardings."""
+        dst, pad to the mesh size, derive per-shard row pointers, and
+        place arrays with explicit shardings."""
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         g = TrustGraph(g.n, g.src, g.dst, w, g.pre_trusted)
@@ -60,8 +73,17 @@ class ShardedTrustProblem:
         n_shards = mesh.shape[SHARD_AXIS]
         pad = (-g.nnz) % n_shards
         src = np.concatenate([g.src, np.zeros(pad, np.int32)])
-        dst = np.concatenate([g.dst, np.zeros(pad, np.int32)])
         wpad = np.concatenate([g.weight, np.zeros(pad, np.float32)])
+        # Per-shard CSR-by-dst pointers: clip the global pointer array
+        # to each shard's slice.  A destination whose edges straddle a
+        # shard cut gets a partial range on both sides — each shard
+        # contributes its partial row sum and the psum completes it.
+        # Pad-tail slots (w=0) sit beyond every clipped pointer and are
+        # never differenced into any row.
+        gptr = g.row_ptr_by_dst().astype(np.int64)
+        m = (g.nnz + pad) // n_shards
+        starts = np.arange(n_shards, dtype=np.int64)[:, None] * m
+        row_ptr = (np.clip(gptr[None, :], starts, starts + m) - starts).astype(np.int32)
 
         edge_sharding = NamedSharding(mesh, P(SHARD_AXIS))
         repl = NamedSharding(mesh, P())
@@ -69,8 +91,8 @@ class ShardedTrustProblem:
             mesh=mesh,
             n=g.n,
             src=jax.device_put(src, edge_sharding),
-            dst=jax.device_put(dst, edge_sharding),
             w=jax.device_put(wpad, edge_sharding),
+            row_ptr=jax.device_put(row_ptr, NamedSharding(mesh, P(SHARD_AXIS, None))),
             p=jax.device_put(graph.pre_trust_vector(), repl),
             dangling=jax.device_put(dangling.astype(np.float32), repl),
         )
@@ -93,27 +115,38 @@ def _get_runner(mesh: Mesh, n: int):
         return _RUN_CACHE[key]
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P(), P(), P()),
+        in_specs=(
+            P(SHARD_AXIS),
+            P(SHARD_AXIS),
+            P(SHARD_AXIS, None),
+            P(),
+            P(),
+            P(),
+            P(),
+        ),
         out_specs=P(),
     )
-    def step(src, dst, w, t, p, dangling, alpha):
+    def step(src, w, row_ptr, t, p, dangling, alpha):
+        from ..ops.sparse import rowsum_sorted
+
+        # The same scatter-free cumsum rowsum as the single-device CSR
+        # fast path (ops.sparse.power_step_csr); boundary rows split
+        # across shards are completed by the psum below.
         contrib = w * t[src]
-        partial_ct = jax.ops.segment_sum(
-            contrib, dst, num_segments=n, indices_are_sorted=True
-        )
+        partial_ct = rowsum_sorted(contrib, row_ptr[0])
         ct = lax.psum(partial_ct, SHARD_AXIS)
         dangling_mass = jnp.sum(t * dangling)
         t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
         return t_new / jnp.sum(t_new)
 
     @partial(jax.jit, static_argnames=("max_iter", "tol"))
-    def run(src, dst, w, t0, p, dangling, alpha, *, max_iter, tol):
+    def run(src, w, row_ptr, t0, p, dangling, alpha, *, max_iter, tol):
         from ..ops.sparse import run_power_iteration
 
         return run_power_iteration(
-            lambda t: step(src, dst, w, t, p, dangling, alpha),
+            lambda t: step(src, w, row_ptr, t, p, dangling, alpha),
             t0,
             tol=tol,
             max_iter=max_iter,
@@ -138,8 +171,8 @@ def converge_sharded(
     run = _get_runner(problem.mesh, problem.n)
     t, it, resid = run(
         problem.src,
-        problem.dst,
         problem.w,
+        problem.row_ptr,
         problem.t0(),
         problem.p,
         problem.dangling,

@@ -1,14 +1,23 @@
 """TrustBackend — the pluggable execution backend for trust convergence.
 
 The north-star design: the node selects how the epoch's convergence runs
-(BASELINE.json: "native-cpu | tpu-pjrt"), generalized here to five
+(BASELINE.json: "native-cpu | tpu-pjrt"), generalized here to six
 backends along the scaling ladder:
 
-- ``native-cpu``   exact field/rational math (parity with the reference)
-- ``tpu-dense``    jit'd dense matmul power iteration (≤ ~10k peers)
-- ``tpu-sparse``   COO segment-sum SpMV, single device
-- ``tpu-csr``      gather-only CSR/compensated-cumsum SpMV (scatter-free)
-- ``tpu-sharded``  edge-sharded SpMV + psum over a device mesh
+- ``native-cpu``    exact field/rational math (parity with the reference)
+- ``tpu-dense``     jit'd dense matmul power iteration (≤ ~10k peers)
+- ``tpu-sparse``    COO segment-sum SpMV, single device
+- ``tpu-csr``       gather-only CSR/compensated-cumsum SpMV (scatter-free)
+- ``tpu-windowed``  fused fixed-slot pipeline: windowed Pallas gather from
+  a VMEM-resident score table + static bucket→dst bridge (PERF.md §7).
+  Needs a static graph layout (the one-time ``WindowPlan``, reusable
+  across epochs/reboots while the graph fingerprint holds) and a score
+  table that fits VMEM as one window set (≤ 4 MB ⇒ ≤ ~1M peers f32).
+  Prefer ``tpu-csr`` when the graph churns every epoch (plan cost is
+  then per-epoch), when N exceeds the VMEM table cap, or on toolchains
+  where Mosaic is unavailable.
+- ``tpu-sharded``   edge-sharded SpMV + psum over a device mesh (shares
+  the CSR ``rowsum_sorted`` kernel via per-shard row pointers)
 
 All float backends compute the damped EigenTrust fixed point over the
 row-normalized graph; ``native-cpu`` additionally reproduces the
@@ -20,10 +29,17 @@ from __future__ import annotations
 from dataclasses import dataclass
 from fractions import Fraction
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops.dense import converge_dense
+from ..ops.gather_window import (
+    WindowPlan,
+    build_window_plan,
+    converge_windowed,
+    graph_fingerprint,
+)
 from ..ops.sparse import converge_csr, converge_sparse
 from .graph import TrustGraph
 
@@ -206,6 +222,65 @@ class CsrJaxBackend(TrustBackend):
         )
 
 
+class WindowedJaxBackend(TrustBackend):
+    """Fused fixed-slot pipeline (PERF.md §7): windowed Pallas
+    gather-multiply from a VMEM-resident score table + a static
+    bucket→dst bridge, so the per-iteration device step performs no
+    O(E) random gather.
+
+    The one-time ``WindowPlan`` (host bucketing + reduction layout) is
+    cached on the instance and revalidated by graph fingerprint, so
+    repeated epochs over a stable graph — and reboots that restore the
+    plan from a checkpoint — skip construction entirely.
+    """
+
+    name = "tpu-windowed"
+
+    def __init__(self, plan: WindowPlan | None = None, interpret: bool | None = None):
+        #: Candidate plan to reuse (e.g. checkpoint-restored); replaced
+        #: when its fingerprint doesn't match the converged graph.
+        self.plan = plan
+        #: Pallas interpret mode; default: interpret off real TPUs only
+        #: (CPU test runs exercise the identical lowered computation).
+        self.interpret = interpret
+        #: The plan the last converge actually used (for persistence).
+        self.last_plan: WindowPlan | None = plan
+
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+        g = graph.drop_self_edges()
+        w, dangling = g.row_normalized()
+        fp = graph_fingerprint(g.n, g.src, g.dst, w)
+        plan = self.plan
+        if plan is None or plan.fingerprint != fp:
+            plan = build_window_plan(g.src, g.dst, w, n=g.n)
+            self.plan = plan
+        self.last_plan = plan
+        p = graph.pre_trust_vector()
+        interpret = (
+            self.interpret
+            if self.interpret is not None
+            else jax.default_backend() != "tpu"
+        )
+        t, it, resid = converge_windowed(
+            *plan.device_args(),
+            jnp.asarray(p),
+            jnp.asarray(p),
+            jnp.asarray(dangling.astype(np.float32)),
+            n_rows=plan.n_rows,
+            table_entries=plan.table_entries,
+            alpha=jnp.float32(alpha),
+            tol=tol,
+            max_iter=max_iter,
+            interpret=interpret,
+        )
+        return ConvergenceResult(
+            scores=np.asarray(t, dtype=np.float64),
+            iterations=int(it),
+            residual=float(resid),
+            backend=self.name,
+        )
+
+
 class ShardedJaxBackend(TrustBackend):
     name = "tpu-sharded"
 
@@ -234,6 +309,7 @@ _BACKENDS = {
     "tpu-dense": DenseJaxBackend,
     "tpu-sparse": SparseJaxBackend,
     "tpu-csr": CsrJaxBackend,
+    "tpu-windowed": WindowedJaxBackend,
     "tpu-sharded": ShardedJaxBackend,
 }
 

@@ -8,18 +8,22 @@ cd "$(dirname "$0")/.."
 
 python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.py
 
+# Trees held to the hard format/type gates: the convergence-kernel and
+# backend code the fused-pipeline work (PERF.md §7) touches.  The rest
+# of the tree stays informational until it is brought up to the wall.
+HARD_TREES="protocol_tpu/ops protocol_tpu/trust"
+
 if command -v ruff >/dev/null 2>&1; then
     ruff check .
-    # The format and type gates are informational until first exercised
-    # on a ruff/mypy-equipped machine (this build image has neither, so
-    # they have never run against this tree).  Flip them to hard gates
-    # by removing the trailing `|| ...` once the tree is formatted.
-    ruff format --check . || echo "lint: format drift (informational)" >&2
+    # Hard gate on the kernel/backend trees; informational elsewhere.
+    ruff format --check $HARD_TREES
+    ruff format --check . || echo "lint: format drift outside $HARD_TREES (informational)" >&2
 else
     echo "lint: ruff not installed; ran compileall floor only" >&2
 fi
 if command -v mypy >/dev/null 2>&1; then
-    mypy protocol_tpu || echo "lint: mypy findings (informational)" >&2
+    mypy $HARD_TREES
+    mypy protocol_tpu || echo "lint: mypy findings outside $HARD_TREES (informational)" >&2
 else
     echo "lint: mypy not installed; skipped type gate" >&2
 fi

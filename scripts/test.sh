@@ -1,5 +1,14 @@
 #!/usr/bin/env bash
 # Full test suite (the reference's scripts/test.sh: cargo test --all).
+#
+# `--tier1` runs the driver's gate exactly: CPU platform, everything not
+# marked slow — which includes the interpret-mode windowed-pipeline
+# equivalence tests (tests/test_windowed_pipeline.py, PERF.md §7).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--tier1" ]]; then
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' "$@"
+fi
 python -m pytest tests/ -q "$@"

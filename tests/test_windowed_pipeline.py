@@ -1,0 +1,230 @@
+"""Fused fixed-slot pipeline (PERF.md §7) — interpret-mode equivalence
+of the ``tpu-windowed`` backend against ``tpu-csr``/``native-cpu``,
+``bucket_by_window`` layout properties, and WindowPlan persistence
+through the checkpoint store.
+
+Everything runs under the conftest CPU platform: the Pallas kernel
+executes in interpret mode (the identical lowered computation, minus
+Mosaic codegen), which is the test doctrine PERF.md §6 establishes for
+the windowed gather.
+"""
+
+import numpy as np
+import pytest
+
+from protocol_tpu.models.graphs import erdos_renyi, scale_free
+from protocol_tpu.node.checkpoint import CheckpointStore
+from protocol_tpu.node.epoch import Epoch
+from protocol_tpu.ops.gather_window import (
+    WINDOW,
+    WindowPlan,
+    bucket_by_window,
+    build_window_plan,
+    graph_fingerprint,
+)
+from protocol_tpu.trust.backend import WindowedJaxBackend, get_backend
+from protocol_tpu.trust.graph import TrustGraph
+
+
+def drop_out_edges(g: TrustGraph, peers) -> TrustGraph:
+    """Make ``peers`` dangling by removing every edge they send."""
+    keep = ~np.isin(g.src, np.asarray(peers, dtype=np.int32))
+    return TrustGraph(g.n, g.src[keep], g.dst[keep], g.weight[keep], g.pre_trusted)
+
+
+def l1(a, b) -> float:
+    return float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+
+
+class TestWindowedBackendEquivalence:
+    """Acceptance: tpu-windowed matches tpu-csr to ≤1e-5 L1 in CPU
+    interpret mode, including dangling rows and non-aligned N."""
+
+    def test_matches_csr_erdos_renyi_non_aligned(self):
+        # 773 peers: not divisible by WINDOW (table padding in play),
+        # plus forced dangling rows (out-edge-free peers).
+        g = drop_out_edges(erdos_renyi(773, avg_degree=5.0, seed=1), [0, 17, 772])
+        csr = get_backend("tpu-csr").converge(g, alpha=0.1, tol=1e-9, max_iter=60)
+        win = get_backend("tpu-windowed").converge(g, alpha=0.1, tol=1e-9, max_iter=60)
+        assert l1(win.scores, csr.scores) <= 1e-5
+        assert win.backend == "tpu-windowed"
+        assert win.scores.sum() == pytest.approx(1.0, rel=1e-5)
+
+    def test_matches_csr_scale_free(self):
+        g = scale_free(1500, 9000, seed=2)
+        csr = get_backend("tpu-csr").converge(g, alpha=0.1, tol=1e-9, max_iter=60)
+        win = get_backend("tpu-windowed").converge(g, alpha=0.1, tol=1e-9, max_iter=60)
+        assert l1(win.scores, csr.scores) <= 1e-5
+
+    def test_matches_csr_multi_window(self):
+        # n > WINDOW so the kernel resolves across several table windows.
+        g = drop_out_edges(scale_free(3 * WINDOW + 137, 20000, seed=3), [5, 2048])
+        csr = get_backend("tpu-csr").converge(g, alpha=0.15, tol=0, max_iter=30)
+        win = get_backend("tpu-windowed").converge(g, alpha=0.15, tol=0, max_iter=30)
+        assert l1(win.scores, csr.scores) <= 1e-5
+        assert win.iterations == 30  # fixed-iter mode drives the same driver
+
+    def test_matches_exact_native(self):
+        g = erdos_renyi(40, avg_degree=4.0, seed=2)
+        exact = get_backend("native-cpu").converge(g, alpha=0.15, tol=0, max_iter=25)
+        win = get_backend("tpu-windowed").converge(g, alpha=0.15, tol=0, max_iter=25)
+        assert l1(win.scores, exact.scores) <= 1e-5
+
+    def test_plan_reuse_and_rebuild(self):
+        g = erdos_renyi(600, avg_degree=5.0, seed=4)
+        backend = WindowedJaxBackend()
+        backend.converge(g, alpha=0.1, max_iter=10)
+        plan_first = backend.last_plan
+        backend.converge(g, alpha=0.1, max_iter=10)
+        assert backend.last_plan is plan_first  # fingerprint hit: no rebuild
+        g2 = erdos_renyi(600, avg_degree=5.0, seed=5)
+        backend.converge(g2, alpha=0.1, max_iter=10)
+        assert backend.last_plan is not plan_first  # graph changed: rebuilt
+
+    def test_registry_constructs_windowed(self):
+        assert get_backend("tpu-windowed").name == "tpu-windowed"
+
+
+class TestBucketByWindowProperties:
+    def _random_edges(self, seed, n=3000, e=20000):
+        rng = np.random.default_rng(seed)
+        src = rng.integers(0, n, e).astype(np.int32)
+        dst = rng.integers(0, n, e).astype(np.int32)
+        w = rng.random(e, dtype=np.float32)
+        return n, src, dst, w
+
+    def test_order_out_pos_round_trip(self):
+        """``order``/``out_pos`` reconstruct the input edge list exactly:
+        slot out_pos[k] carries edge order[k]'s window-local src and
+        weight, every other slot is zero-weight padding."""
+        n, src, dst, w = self._random_edges(7)
+        b = bucket_by_window(src, w, table_size=n)
+        order, out_pos = b["order"], b["out_pos"]
+        assert sorted(order.tolist()) == list(range(len(src)))  # permutation
+        local = b["local"].reshape(-1)
+        weight = b["weight"].reshape(-1)
+        np.testing.assert_array_equal(local[out_pos], src[order] % WINDOW)
+        np.testing.assert_array_equal(weight[out_pos], w[order])
+        pad = np.ones(weight.shape[0], bool)
+        pad[out_pos] = False
+        assert not weight[pad].any()
+        # Each slot's global src index re-derives from wid + local.
+        wid = np.repeat(b["wid"], WINDOW)
+        np.testing.assert_array_equal(
+            (wid[out_pos].astype(np.int64) * WINDOW + local[out_pos]), src[order]
+        )
+
+    def test_rejects_out_of_range_src(self):
+        n, src, dst, w = self._random_edges(8, n=500, e=100)
+        bad = src.copy()
+        bad[3] = 500  # == table_size
+        with pytest.raises(ValueError, match="src index"):
+            bucket_by_window(bad, w, table_size=500)
+        neg = src.copy()
+        neg[0] = -1
+        with pytest.raises(ValueError, match="src index"):
+            bucket_by_window(neg, w, table_size=500)
+
+    def test_rejects_out_of_range_dst(self):
+        n, src, dst, w = self._random_edges(9, n=500, e=100)
+        bad = dst.copy()
+        bad[5] = 700
+        with pytest.raises(ValueError, match="dst index"):
+            bucket_by_window(src, w, table_size=500, dst=bad, n_dst=500)
+        with pytest.raises(ValueError, match="n_dst"):
+            bucket_by_window(src, w, table_size=500, dst=dst)
+
+    def test_segment_plan_reduces_exactly(self):
+        """The static two-level plan is a partition of the slots: summing
+        contributions by segment and then by ``dst_ptr`` range equals the
+        direct per-dst sum of w·x[src]."""
+        n, src, dst, w = self._random_edges(10)
+        b = bucket_by_window(src, w, table_size=n, dst=dst, n_dst=n)
+        rng = np.random.default_rng(11)
+        x = rng.random(n).astype(np.float32)
+        contrib = np.zeros(b["n_rows"] * WINDOW, np.float64)
+        contrib[b["out_pos"]] = (w[b["order"]].astype(np.float64)
+                                 * x[src[b["order"]]].astype(np.float64))
+        cum = np.concatenate([[0.0], np.cumsum(contrib)])
+        partial = cum[b["seg_end"].astype(np.int64) + 1] - cum[b["seg_start"].astype(np.int64)]
+        ptr = b["dst_ptr"].astype(np.int64)
+        per_dst = np.add.reduceat(
+            np.concatenate([partial, [0.0]]), np.minimum(ptr[:-1], len(partial))
+        )
+        per_dst[ptr[:-1] == ptr[1:]] = 0.0
+        expect = np.zeros(n)
+        np.add.at(expect, dst, w.astype(np.float64) * x[src].astype(np.float64))
+        np.testing.assert_allclose(per_dst, expect, rtol=1e-5, atol=1e-12)
+        # Segments never span a vreg-row (the device prefix sum resets
+        # per row), and runs are dst-sorted by construction.
+        assert (b["seg_start"] // WINDOW == b["seg_end"] // WINDOW).all()
+        assert (b["seg_start"] <= b["seg_end"]).all()
+
+
+class TestWindowPlanCheckpoint:
+    def _plan(self, seed=12, n=900):
+        g = scale_free(n, 5000, seed=seed).drop_self_edges()
+        w, _ = g.row_normalized()
+        return build_window_plan(g.src, g.dst, w, n=g.n)
+
+    def test_round_trips_through_store(self, tmp_path):
+        plan = self._plan()
+        g = erdos_renyi(30, seed=13)
+        store = CheckpointStore(tmp_path)
+        store.save(Epoch(9), g, plan=plan)
+        snap = store.load_latest()
+        assert snap.plan is not None
+        assert snap.plan.fingerprint == plan.fingerprint
+        assert (snap.plan.n, snap.plan.n_rows) == (plan.n, plan.n_rows)
+        assert (snap.plan.table_entries, snap.plan.n_segments) == (
+            plan.table_entries,
+            plan.n_segments,
+        )
+        for k in WindowPlan._CORE:
+            np.testing.assert_array_equal(getattr(snap.plan, k), getattr(plan, k))
+        # Checkpoints persist only the core arrays (order/out_pos are
+        # test/diagnostic-only and E-sized).
+        assert snap.plan.order is None and snap.plan.out_pos is None
+
+    def test_restored_plan_skips_rebuild(self, tmp_path, monkeypatch):
+        g = scale_free(900, 5000, seed=12).drop_self_edges()
+        w, _ = g.row_normalized()
+        plan = build_window_plan(g.src, g.dst, w, n=g.n)
+        store = CheckpointStore(tmp_path)
+        store.save(Epoch(1), g, plan=plan)
+        restored = store.load_latest().plan
+
+        import protocol_tpu.trust.backend as backend_mod
+
+        def boom(*a, **k):  # a fingerprint hit must not reconstruct
+            raise AssertionError("plan rebuilt despite checkpoint restore")
+
+        monkeypatch.setattr(backend_mod, "build_window_plan", boom)
+        backend = WindowedJaxBackend(plan=restored)
+        res = backend.converge(g, alpha=0.1, tol=1e-9, max_iter=40)
+        csr = get_backend("tpu-csr").converge(g, alpha=0.1, tol=1e-9, max_iter=40)
+        assert l1(res.scores, csr.scores) <= 1e-5
+
+    def test_prune_removes_plan_files(self, tmp_path):
+        store = CheckpointStore(tmp_path, keep=1)
+        g = erdos_renyi(30, seed=14)
+        plan = self._plan()
+        store.save(Epoch(1), g, plan=plan)
+        store.save(Epoch(2), g, plan=plan)
+        assert not (tmp_path / "epoch_1.plan.npz").exists()
+        assert (tmp_path / "epoch_2.plan.npz").exists()
+
+    def test_no_plan_is_fine(self, tmp_path):
+        store = CheckpointStore(tmp_path)
+        store.save(Epoch(4), erdos_renyi(10, seed=15))
+        assert store.load_latest().plan is None
+
+    def test_fingerprint_sensitivity(self):
+        g = scale_free(700, 4000, seed=16).drop_self_edges()
+        w, _ = g.row_normalized()
+        fp = graph_fingerprint(g.n, g.src, g.dst, w)
+        assert fp == graph_fingerprint(g.n, g.src, g.dst, w)  # deterministic
+        w2 = w.copy()
+        w2[0] += 0.5
+        assert fp != graph_fingerprint(g.n, g.src, g.dst, w2)
+        assert fp != graph_fingerprint(g.n + 1, g.src, g.dst, w)
