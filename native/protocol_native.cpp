@@ -276,9 +276,12 @@ static bool pt_eq_affine(const Pt &p, const Pt &q) {
 extern "C" {
 
 // Batch width-5 Poseidon: inputs (n, 5, 4) u64 canonical; outputs the
-// full final state (n, 5, 4).
+// full final state (n, 5, 4).  The if-clause keeps tiny batches (the
+// per-attestation ingest path calls with n in the single digits) off
+// the thread-team fork, the same guard pattern as zk_runtime.cpp's
+// NTT/vector loops.
 void poseidon5_permute_batch(const uint64_t *inputs, uint64_t *outputs, int64_t n) {
-#pragma omp parallel for schedule(static)
+#pragma omp parallel for schedule(static) if (n >= 16)
     for (int64_t k = 0; k < n; ++k) {
         Fr state[5];
         for (int j = 0; j < 5; ++j) fr_to_mont(state[j], inputs + (k * 5 + j) * 4);
@@ -289,7 +292,7 @@ void poseidon5_permute_batch(const uint64_t *inputs, uint64_t *outputs, int64_t 
 
 // Batch pk-hash: Poseidon(x, y, 0, 0, 0)[0]  (manager/mod.rs:101-120).
 void pk_hash_batch(const uint64_t *xs, const uint64_t *ys, uint64_t *out, int64_t n) {
-#pragma omp parallel for schedule(static)
+#pragma omp parallel for schedule(static) if (n >= 16)
     for (int64_t k = 0; k < n; ++k) {
         Fr state[5];
         fr_to_mont(state[0], xs + k * 4);
@@ -312,10 +315,19 @@ void eddsa_verify_batch(const uint64_t *rx, const uint64_t *ry, const uint64_t *
     fr_set(b8.y, BJJ_B8_Y_MONT);
     fr_set(b8.z, FR_ONE_MONT);
 
-    // Per-signature message hashes first (cheap next to the curve ops).
+    // One parallel region for both phases — the pragma pattern
+    // zk_runtime.cpp's gate evaluator uses (one team, work-shared
+    // loops) — so the team forks once per batch, not once per phase;
+    // the implicit barrier after the hash loop orders m_hash against
+    // the scalar-mul reads.  The if-clause keeps the n=1 per-ingest
+    // verify path serial (no fork on the event-loop hot path).
     std::vector<uint64_t> m_hash(n * 4);
     std::vector<uint8_t> s_ok(n);
-#pragma omp parallel for schedule(static)
+    static const uint64_t DUMMY[4] = {1, 0, 0, 0};
+#pragma omp parallel if (n >= 4)
+    {
+    // Per-signature message hashes first (cheap next to the curve ops).
+#pragma omp for schedule(static)
     for (int64_t k = 0; k < n; ++k) {
         s_ok[k] = limbs_le(s + k * 4, BJJ_SUBORDER) ? 1 : 0;
         Fr state[5];
@@ -331,9 +343,9 @@ void eddsa_verify_batch(const uint64_t *rx, const uint64_t *ry, const uint64_t *
     // Scalar muls four signatures at a time: lanes [0..3] hold B8*s and
     // PK*m_hash for two signatures each, so every group of 4 lanes
     // completes two signatures.  Rejected-s slots run with a dummy
-    // scalar and are overwritten below.
-    static const uint64_t DUMMY[4] = {1, 0, 0, 0};
-#pragma omp parallel for schedule(dynamic, 8)
+    // scalar and are overwritten below.  Dynamic schedule: adversarial
+    // batches make group cost bimodal (dummy-only groups skip).
+#pragma omp for schedule(dynamic, 8)
     for (int64_t g = 0; g < (n + 1) / 2; ++g) {
         int64_t k0 = 2 * g, k1 = 2 * g + 1;
         bool have1 = k1 < n;
@@ -385,6 +397,7 @@ void eddsa_verify_batch(const uint64_t *rx, const uint64_t *ry, const uint64_t *
             ok[k] = pt_eq_affine(cr, res[2 * j]) ? 1 : 0;
         }
     }
+    }  // omp parallel
 }
 
 // Library self-check hook (parity with Python golden vectors is tested

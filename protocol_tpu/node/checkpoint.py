@@ -10,10 +10,12 @@ replay catches up.
 
 Format: ``<dir>/epoch_<N>.npz`` (numpy arrays) + ``manifest.json``
 pointing at the latest; writes are atomic (tmp + rename).  When the
-node converges on the ``tpu-windowed`` backend, the one-time bucketing
-plan (ops.gather_window.WindowPlan — the expensive host-side layout)
-rides along as ``epoch_<N>.plan.npz`` so a reboot revalidates it by
-fingerprint instead of rebuilding it.
+node converges on a windowed backend (``tpu-windowed`` or
+``tpu-sharded:tpu-windowed``), the one-time bucketing plan
+(ops.gather_window.WindowPlan — the expensive host-side layout) rides
+along as ``epoch_<N>.plan.npz`` so a reboot revalidates it by
+fingerprint + layout version instead of rebuilding it; a sidecar from
+a stale plan-format version is ignored (rebuild on first converge).
 """
 
 from __future__ import annotations
@@ -136,7 +138,14 @@ class CheckpointStore:
         plan = None
         if plan_path.exists():
             with np.load(plan_path) as pz:
-                plan = WindowPlan.from_arrays(pz)
+                try:
+                    plan = WindowPlan.from_arrays(pz)
+                except (ValueError, KeyError):
+                    # Plan written by an older layout version (e.g. the
+                    # pre-v2 dst-sorted boundary pairs): snapshots are an
+                    # optimization, never a source of truth, so a stale
+                    # sidecar degrades to a rebuild on first converge.
+                    plan = None
         return Snapshot(
             epoch=epoch, graph=graph, scores=scores, proof_json=proof_json, plan=plan
         )

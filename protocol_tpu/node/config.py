@@ -19,8 +19,11 @@ class ProtocolConfig:
     as_contract_address: str = "0x" + "0" * 40
     # Rebuild-specific (absent from reference configs; defaulted).
     # Any trust/backend.py ladder rung: native-cpu | tpu-dense |
-    # tpu-sparse | tpu-csr | tpu-windowed | tpu-sharded.  tpu-windowed
-    # additionally persists its bucketing plan with each checkpoint.
+    # tpu-sparse | tpu-csr | tpu-windowed | tpu-sharded (optionally
+    # with a per-shard kernel suffix, e.g. "tpu-sharded:tpu-windowed"
+    # for the fused pipeline on a real multi-chip mesh).  The windowed
+    # backends additionally persist their bucketing plan with each
+    # checkpoint.
     trust_backend: str = "native-cpu"
     event_fixture: str | None = None
     checkpoint_dir: str | None = None

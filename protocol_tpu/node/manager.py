@@ -21,7 +21,7 @@ import numpy as np
 from ..crypto import calculate_message_hash, field
 from ..crypto.eddsa import PublicKey, sign, verify as verify_sig
 from ..ops.gather_window import WindowPlan
-from ..trust.backend import ConvergenceResult, WindowedJaxBackend, get_backend
+from ..trust.backend import ConvergenceResult, get_backend
 from ..trust.graph import TrustGraph
 from ..trust.native import power_iterate
 from ..zk.proof import PoseidonCommitmentProver, Proof, Prover
@@ -40,8 +40,10 @@ class ManagerConfig:
     fixed_set: list[tuple[str, str]] = dc_field(default_factory=lambda: list(FIXED_SET))
     #: TrustBackend for the open-graph convergence (trust/backend.py
     #: ladder: native-cpu | tpu-dense | tpu-sparse | tpu-csr |
-    #: tpu-windowed | tpu-sharded).  tpu-windowed reuses the manager's
-    #: cached WindowPlan across epochs.
+    #: tpu-windowed | tpu-sharded[:tpu-csr|:tpu-windowed]).
+    #: tpu-windowed — and the sharded windowed kernel on real
+    #: multi-chip meshes — reuses the manager's cached WindowPlan
+    #: across epochs.
     backend: str = "native-cpu"
     #: Run the constraint-system statement check before each proof —
     #: the reference's always-on MockProver sanity pass.
@@ -80,9 +82,11 @@ class Manager:
         self.cached_results: dict[Epoch, ConvergenceResult] = {}
         #: The graph the most recent converge_epoch ran on.
         self.last_graph: TrustGraph | None = None
-        #: Bucketing plan for the tpu-windowed backend: built on first
-        #: converge, revalidated by fingerprint each epoch, seeded from
-        #: a checkpoint at boot so a reboot skips reconstruction.
+        #: Bucketing plan for the windowed backends (tpu-windowed and
+        #: tpu-sharded:tpu-windowed): built on first converge,
+        #: revalidated by fingerprint + layout version each epoch,
+        #: seeded from a checkpoint at boot so a reboot skips
+        #: reconstruction.
         self.window_plan: WindowPlan | None = None
         _, self._group_pks = keyset_from_raw(self.config.fixed_set)
         self._group_hashes = [pk.hash() for pk in self._group_pks]
@@ -283,10 +287,13 @@ class Manager:
         persist exactly the graph the scores belong to."""
         graph = self.build_graph()
         backend = get_backend(self.config.backend)
-        if isinstance(backend, WindowedJaxBackend):
+        # Plan-carrying backends (tpu-windowed, tpu-sharded:tpu-windowed)
+        # expose plan/last_plan; seed from the manager's cache and keep
+        # whatever the converge actually used, so checkpoints persist it.
+        if hasattr(backend, "plan"):
             backend.plan = self.window_plan
         result = backend.converge(graph, alpha=alpha, tol=tol, max_iter=max_iter)
-        if isinstance(backend, WindowedJaxBackend):
+        if getattr(backend, "last_plan", None) is not None:
             self.window_plan = backend.last_plan
         self.last_graph = graph
         self.cached_results[epoch] = result

@@ -3,11 +3,14 @@ and the fused windowed (fixed-slot) pipeline."""
 
 from .dense import converge_dense, filter_and_normalize, set_converge_dense  # noqa: F401
 from .gather_window import (  # noqa: F401
+    PLAN_VERSION,
     WindowPlan,
+    bridge_partials,
     bucket_by_window,
     build_window_plan,
     converge_windowed,
     gather_windowed,
     power_step_windowed,
+    windowed_ct,
 )
 from .sparse import converge_csr, converge_sparse, power_step_coo, power_step_csr  # noqa: F401

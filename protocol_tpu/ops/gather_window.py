@@ -18,18 +18,22 @@ selects.  This module composes exactly those into a windowed gather and
   as (rows, 128); per vreg-row the kernel dynamic-slices the (8, 128)
   window and resolves the 1024 local indices with an 8-way
   broadcast/lane-gather/select chain (~30 vreg ops per 1024 edges).
-- Bridge side (`power_step_windowed`, PERF.md §7): the kernel output is
-  in *bucket order*, not the dst order the rowsum needs.  Bridging
+- Bridge side (`power_step_windowed`, PERF.md §7-8): the kernel output
+  is in *bucket order*, not the dst order the rowsum needs.  Bridging
   per-edge would itself be an O(E) random gather (the circularity that
   stalled PERF.md §1).  Instead `bucket_by_window` additionally sorts
-  each window's edges by dst and emits a static two-level reduction
-  plan: per-(vreg-row, dst) runs reduce locally out of a row-local
-  compensated prefix sum (two static boundary gathers over the
-  ``n_segments`` run boundaries), and only those partials — not the E
-  edge contributions — cross the bucket→dst boundary through the
-  existing ``rowsum_sorted`` machinery via a host-precomputed
-  dst-sorted layout.  Per iteration the device touches random memory
-  only at segment boundaries: O(n_segments + N) with
+  each window's edges by dst and emits a static single-pass reduction
+  plan (`bridge_partials`): the (hi, lo) lanes of the row-local
+  compensated prefix sum are interleaved into one (slots, 2) array so a
+  single 2-wide slice gather at the run *ends* — in bucket order, where
+  the end slots are strictly increasing, so the read streams — fetches
+  both lanes of every boundary at once; each run's start prefix is just
+  the *previous gathered element* (runs are consecutive within a
+  vreg-row), so the differencing is a shift, not a second gather; and
+  the one host-precomputed dst permutation of the resulting
+  ``n_segments`` partials is the only data-randomly-addressed pass per
+  iteration.  Random volume: 1× n_segments (was 4× — hi/lo at both
+  boundaries — before the interleave, PERF.md §7 open variable), with
   ``n_segments <= min(E, n_windows · N)``, which the hub-heavy bench
   graph compresses far below E (the plan records the measured ratio).
 
@@ -144,11 +148,14 @@ def bucket_by_window(
 
     With ``dst`` (and ``n_dst``) given, edges are additionally sorted by
     destination *within* each window and the dict gains the static
-    bucket→dst reduction plan (PERF.md §7): ``seg_start``/``seg_end``
-    flat slot bounds of every per-(vreg-row, dst) run, already permuted
-    into dst order, and ``dst_ptr`` delimiting each destination's runs —
+    single-pass reduction plan (PERF.md §7-8): ``seg_end`` flat end
+    slots of every per-(vreg-row, dst) run in *bucket order* (strictly
+    increasing — the boundary read streams), ``seg_first`` flagging
+    row-leading runs (whose start prefix is an exact zero),
+    ``seg_perm`` the bucket→dst permutation of the run partials, and
+    ``dst_ptr`` delimiting each destination's runs in permuted order —
     everything ``power_step_windowed`` needs to reduce bucket-order
-    contributions to a dense Cᵀt with no O(E) random access.
+    contributions to a dense Cᵀt with one n_segments-sized random pass.
 
     Fully vectorized: stable counting sorts (scipy COO→CSR, O(E)) plus
     cumulative-count placement — the previous per-window Python loop
@@ -249,7 +256,7 @@ def bucket_by_window(
     if ds is None:
         return result
 
-    # -- static two-level reduction plan (PERF.md §7) -------------------
+    # -- static single-pass reduction plan (PERF.md §7-8) ---------------
     # Segments are maximal same-dst slot runs within one vreg-row: edges
     # are dst-sorted inside each window and packed into consecutive
     # slots, so a run breaks only at a dst change or a row boundary (a
@@ -261,25 +268,24 @@ def bucket_by_window(
     end_mask[-1] = True
     end_mask[:-1] = brk[1:]
     seg_dst = ds[brk]
-    # Host-side dst sort of the segment table folds the bucket→dst
-    # permutation into the (static) boundary-gather indices, so the
-    # device never permutes the partials separately; start/end bounds
-    # ride the payload lanes of one S-sized counting sort.
-    sperm, seg_counts, seg_packed = _counting_sort(
-        seg_dst,
-        n_dst,
-        payload=_pack_lanes(out_pos[brk], out_pos[end_mask]),
-    )
-    if seg_packed is None:  # pragma: no cover - numpy fallback
-        seg_start = out_pos[brk].astype(np.int32)[sperm]
-        seg_end = out_pos[end_mask].astype(np.int32)[sperm]
-    else:
-        seg_start, seg_end = _unpack_lanes(seg_packed)
+    # The boundary table stays in BUCKET order: run end slots are then
+    # strictly increasing, so the device's one boundary gather reads
+    # monotonically (streams) instead of jumping dst-to-dst through the
+    # prefix-sum array.  A run's start prefix is the previous run's end
+    # prefix (runs are consecutive within a row) — an on-device shift —
+    # except at row-leading runs, where it is an exact zero.
+    seg_end = np.ascontiguousarray(out_pos[end_mask])
+    seg_first = np.ascontiguousarray(out_pos[brk] & (ROW - 1) == 0)
+    # Host-side dst sort of the segment table becomes a single stored
+    # permutation: the device applies it once to the n_segments run
+    # partials — the only data-randomly-addressed pass per iteration.
+    seg_perm, seg_counts, _ = _counting_sort(seg_dst, n_dst)
     dst_ptr = np.zeros(n_dst + 1, np.int64)
     np.cumsum(seg_counts, out=dst_ptr[1:])
     result.update(
-        seg_start=np.ascontiguousarray(seg_start),
-        seg_end=np.ascontiguousarray(seg_end),
+        seg_end=seg_end,
+        seg_first=seg_first,
+        seg_perm=seg_perm.astype(np.int32, copy=False),
         dst_ptr=dst_ptr.astype(np.int32),
         n_segments=int(seg_dst.shape[0]),
     )
@@ -351,6 +357,15 @@ def gather_windowed(
 # ---------------------------------------------------------------------------
 
 
+#: WindowPlan on-disk/in-memory layout version.  v1 stored dst-sorted
+#: ``seg_start``/``seg_end`` boundary pairs (4 random gathers per
+#: iteration); v2 is the interleaved single-pass layout (bucket-order
+#: ``seg_end`` + row-leading mask + folded dst permutation, PERF.md §8).
+#: Checkpoint-restored plans of any other version are discarded and
+#: rebuilt — the same path a fingerprint mismatch takes.
+PLAN_VERSION = 2
+
+
 @dataclass
 class WindowPlan:
     """Static per-graph layout for the fused windowed power step.
@@ -370,20 +385,22 @@ class WindowPlan:
     wid: np.ndarray  # (n_rows,) int32 window id per vreg-row
     local: np.ndarray  # (n_rows*8, 128) int32 window-local indices
     weight: np.ndarray  # (n_rows*8, 128) f32 slot weights (0 = padding)
-    seg_start: np.ndarray  # (S,) int32 first slot of each run, dst-sorted
-    seg_end: np.ndarray  # (S,) int32 last slot of each run, dst-sorted
+    seg_end: np.ndarray  # (S,) int32 last slot of each run, bucket order
+    seg_first: np.ndarray  # (S,) bool run is row-leading (start prefix = 0)
+    seg_perm: np.ndarray  # (S,) int32 bucket→dst permutation of partials
     dst_ptr: np.ndarray  # (n+1,) int32 run range per destination
     fingerprint: str  # graph identity for safe reuse
+    version: int = PLAN_VERSION  # layout version (see PLAN_VERSION)
     order: np.ndarray | None = None  # (E,) bucket position k ← edge order[k]
     out_pos: np.ndarray | None = None  # (E,) slot of edge order[k]
 
-    _CORE = ("wid", "local", "weight", "seg_start", "seg_end", "dst_ptr")
+    _CORE = ("wid", "local", "weight", "seg_end", "seg_first", "seg_perm", "dst_ptr")
     _META = ("n", "n_rows", "table_entries", "n_segments")
 
     @property
     def compression(self) -> float:
         """Edge contributions per bridge partial (E / n_segments) —
-        how much the two-level reduction shrinks the random-access
+        how much the run-level reduction shrinks the random-access
         volume vs a per-edge bucket→dst permutation."""
         e = int(np.count_nonzero(self.weight)) if self.order is None else len(self.order)
         return e / max(self.n_segments, 1)
@@ -395,6 +412,7 @@ class WindowPlan:
     def to_arrays(self, *, core_only: bool = True) -> dict:
         """npz-ready mapping (checkpoint format)."""
         out = {k: np.int64(getattr(self, k)) for k in self._META}
+        out["version"] = np.int64(self.version)
         out["fingerprint"] = np.bytes_(self.fingerprint.encode())
         for k in self._CORE:
             out[k] = getattr(self, k)
@@ -405,10 +423,20 @@ class WindowPlan:
 
     @classmethod
     def from_arrays(cls, z) -> "WindowPlan":
+        """Rehydrate a persisted plan; raises ``ValueError`` on a stale
+        layout version (pre-v2 plans lack ``version`` entirely) so
+        callers fall back to a rebuild instead of feeding the device
+        mis-shaped boundary arrays."""
+        version = int(z["version"]) if "version" in z else 1
+        if version != PLAN_VERSION:
+            raise ValueError(
+                f"window plan layout v{version} is stale (current v{PLAN_VERSION}); rebuild"
+            )
         return cls(
             **{k: int(z[k]) for k in cls._META},
             **{k: np.asarray(z[k]) for k in cls._CORE},
             fingerprint=bytes(z["fingerprint"]).decode(),
+            version=version,
             order=np.asarray(z["order"]) if "order" in z else None,
             out_pos=np.asarray(z["out_pos"]) if "out_pos" in z else None,
         )
@@ -441,8 +469,9 @@ def build_window_plan(
         wid=b["wid"],
         local=b["local"],
         weight=b["weight"],
-        seg_start=b["seg_start"],
         seg_end=b["seg_end"],
+        seg_first=b["seg_first"],
+        seg_perm=b["seg_perm"],
         dst_ptr=b["dst_ptr"],
         fingerprint=graph_fingerprint(n, src, dst, w),
         order=b["order"],
@@ -450,12 +479,82 @@ def build_window_plan(
     )
 
 
+def bridge_partials(
+    hi: jax.Array,
+    lo: jax.Array,
+    seg_end: jax.Array,
+    seg_first: jax.Array,
+    seg_perm: jax.Array,
+) -> jax.Array:
+    """Reduce the flattened row-local (hi, lo) prefix lanes to
+    dst-sorted per-(row, dst) run partials in a single pass (PERF.md
+    §8): one 2-wide slice gather at the bucket-order run ends (strictly
+    increasing indices — the read streams, and XLA is told so), an
+    adjacent-element shift for each run's start prefix (runs are
+    consecutive within a vreg-row; row-leading runs read an exact
+    zero), and the one host-precomputed dst permutation — the only
+    n_segments-sized random access per iteration."""
+    cum2 = jnp.stack([hi, lo], axis=-1)
+    ends = cum2.at[seg_end].get(indices_are_sorted=True, unique_indices=True)
+    eh, el = ends[:, 0], ends[:, 1]
+    zero = jnp.zeros(1, eh.dtype)
+    prev_h = jnp.where(seg_first, 0.0, jnp.concatenate([zero, eh[:-1]]))
+    prev_l = jnp.where(seg_first, 0.0, jnp.concatenate([zero, el[:-1]]))
+    # Difference hi/lo lanes separately so the hi cancellation stays
+    # exact (Sterbenz), matching rowsum_sorted's row differencing.
+    partial = (eh - prev_h) + (el - prev_l)
+    return partial[seg_perm]
+
+
+def windowed_ct(
+    wid: jax.Array,
+    local: jax.Array,
+    weight: jax.Array,
+    seg_end: jax.Array,
+    seg_first: jax.Array,
+    seg_perm: jax.Array,
+    dst_ptr: jax.Array,
+    t: jax.Array,
+    *,
+    n_rows: int,
+    table_entries: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Dense Cᵀt over the plan's slot set — the fused pipeline minus
+    damping.  Shared verbatim by the single-device step and the
+    per-shard step under ``shard_map`` (``parallel/sharded.py``), where
+    the plan arrays cover one shard's rows/runs and the returned vector
+    is that shard's partial product (completed by ``lax.psum``):
+
+    1. windowed Pallas gather-multiply from the VMEM-resident score
+       table (bucket order — no random access, PERF.md §6: 7.9 ms at
+       50M edges);
+    2. row-local double-single prefix sum over the (n_rows, 1024) slot
+       matrix (sequential vector work, the ``_ds_cumsum`` machinery);
+    3. ``bridge_partials``: run partials out of the interleaved prefix
+       lanes — one streaming boundary read + one n_segments random
+       permutation (PERF.md §8; was 4 random gathers);
+    4. ``rowsum_sorted`` over the dst-delimited partials → dense Cᵀt.
+    """
+    n = t.shape[0]
+    table = jnp.pad(t, (0, table_entries - n))
+    out = gather_windowed(
+        wid, table, local, weight, n_rows=n_rows, interpret=interpret
+    )
+    hi, lo = _ds_cumsum_axis1(out.reshape(n_rows, ROW))
+    partial = bridge_partials(
+        hi.reshape(-1), lo.reshape(-1), seg_end, seg_first, seg_perm
+    )
+    return rowsum_sorted(partial, dst_ptr)
+
+
 def power_step_windowed(
     wid: jax.Array,
     local: jax.Array,
     weight: jax.Array,
-    seg_start: jax.Array,
     seg_end: jax.Array,
+    seg_first: jax.Array,
+    seg_perm: jax.Array,
     dst_ptr: jax.Array,
     t: jax.Array,
     p: jax.Array,
@@ -466,36 +565,21 @@ def power_step_windowed(
     table_entries: int,
     interpret: bool = False,
 ) -> jax.Array:
-    """One damped step of the fused fixed-slot pipeline:
-
-    1. windowed Pallas gather-multiply from the VMEM-resident score
-       table (bucket order — no random access, PERF.md §6: 7.9 ms at
-       50M edges);
-    2. row-local double-single prefix sum over the (n_rows, 1024) slot
-       matrix (sequential vector work, the ``_ds_cumsum`` machinery);
-    3. per-(row, dst) run partials via two static boundary gathers at
-       ``seg_start``/``seg_end`` — the only random access, already in
-       dst order (host-folded permutation), O(n_segments);
-    4. ``rowsum_sorted`` over the dst-sorted partials → dense Cᵀt,
-       then the shared damping + dangling redistribution + L1 renorm.
-    """
-    n = p.shape[0]
-    table = jnp.pad(t, (0, table_entries - n))
-    out = gather_windowed(
-        wid, table, local, weight, n_rows=n_rows, interpret=interpret
+    """One damped step of the fused fixed-slot pipeline: ``windowed_ct``
+    then the shared damping + dangling redistribution + L1 renorm."""
+    ct = windowed_ct(
+        wid,
+        local,
+        weight,
+        seg_end,
+        seg_first,
+        seg_perm,
+        dst_ptr,
+        t,
+        n_rows=n_rows,
+        table_entries=table_entries,
+        interpret=interpret,
     )
-    hi, lo = _ds_cumsum_axis1(out.reshape(n_rows, ROW))
-    fh, fl = hi.reshape(-1), lo.reshape(-1)
-    # Run sum = inclusive_prefix[end] − inclusive_prefix[start−1], with
-    # the row-leading run reading an exact zero (runs never span rows).
-    first = seg_start % ROW == 0
-    prev = jnp.where(first, 0, seg_start - 1)
-    start_h = jnp.where(first, 0.0, fh[prev])
-    start_l = jnp.where(first, 0.0, fl[prev])
-    # Difference hi/lo lanes separately so the hi cancellation stays
-    # exact (Sterbenz), matching rowsum_sorted's row differencing.
-    partial = (fh[seg_end] - start_h) + (fl[seg_end] - start_l)
-    ct = rowsum_sorted(partial, dst_ptr)
     dangling_mass = jnp.sum(t * dangling)
     t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
     return t_new / jnp.sum(t_new)
@@ -509,8 +593,9 @@ def converge_windowed(
     wid: jax.Array,
     local: jax.Array,
     weight: jax.Array,
-    seg_start: jax.Array,
     seg_end: jax.Array,
+    seg_first: jax.Array,
+    seg_perm: jax.Array,
     dst_ptr: jax.Array,
     t0: jax.Array,
     p: jax.Array,
@@ -531,8 +616,9 @@ def converge_windowed(
             wid,
             local,
             weight,
-            seg_start,
             seg_end,
+            seg_first,
+            seg_perm,
             dst_ptr,
             t,
             p,

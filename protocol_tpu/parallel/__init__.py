@@ -8,4 +8,9 @@ transpose-SpMV products over ICI.
 """
 
 from .mesh import default_mesh, shard_count  # noqa: F401
-from .sharded import ShardedTrustProblem, converge_sharded  # noqa: F401
+from .sharded import (  # noqa: F401
+    SHARDED_KERNELS,
+    ShardedTrustProblem,
+    ShardedWindowPlan,
+    converge_sharded,
+)

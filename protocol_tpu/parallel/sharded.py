@@ -23,6 +23,24 @@ XLA place collectives):
   renorm are elementwise on the replicated result so every device stays
   consistent without further communication.
 
+Two kernels share this recipe (``SHARDED_KERNELS``, selectable as
+``tpu-sharded:<kernel>`` in ManagerConfig/ProtocolConfig):
+
+- ``tpu-csr`` — ``ShardedTrustProblem``: the gather-only CSR/cumsum
+  SpMV above, with the O(E) random ``t[src]`` gather per shard.
+- ``tpu-windowed`` — ``ShardedWindowPlan``: the fused fixed-slot
+  pipeline (PERF.md §7-8) taken multi-chip.  The one-time
+  ``WindowPlan`` is partitioned by *window rows*: each shard owns a
+  contiguous, BLOCK_ROWS-aligned slice of the plan's vreg-rows (runs
+  never span rows, so the bucket-order segment table splits at the
+  same cuts), rebased to shard-local slots and padded to the mesh
+  maximum; each shard runs the identical ``windowed_ct`` step —
+  windowed Pallas gather from the replicated score table, row-local
+  prefix sum, single-pass boundary bridge — over its slice, and the
+  per-shard partial Cᵀt vectors are completed by the same ``lax.psum``
+  (dst rows whose runs land on several shards are partially summed on
+  each side, exactly like CSR rows straddling a shard cut).
+
 This is the distributed analog of the reference's single-threaded
 5×5×10 loop (circuit/src/circuit.rs:434-454) at 10^6 peers.
 """
@@ -39,6 +57,16 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..ops.gather_window import (
+    BLOCK_ROWS,
+    PLAN_VERSION,
+    ROW,
+    WindowPlan,
+    _counting_sort,
+    build_window_plan,
+    graph_fingerprint,
+    windowed_ct,
+)
 from ..trust.graph import TrustGraph
 from .mesh import SHARD_AXIS
 
@@ -103,9 +131,11 @@ class ShardedTrustProblem:
         return self.p
 
 
-# Compiled runners keyed by (mesh, n): jax's jit cache is keyed on
-# function identity, so rebuilding the closures per call would recompile
-# the whole while_loop every epoch.
+# Compiled runners keyed by (mesh, n) for the CSR kernel and by
+# (mesh, n, rows_per_shard, table_entries, interpret) for the windowed
+# kernel: jax's jit cache is keyed on function identity, so rebuilding
+# the closures per call would recompile the whole while_loop every
+# epoch.
 _RUN_CACHE: dict = {}
 
 
@@ -156,18 +186,256 @@ def _get_runner(mesh: Mesh, n: int):
     return run
 
 
+@dataclass
+class ShardedWindowPlan:
+    """Mesh-partitioned fused-pipeline layout: the ``tpu-windowed``
+    kernel of ``converge_sharded``.
+
+    Host construction slices the single-graph ``WindowPlan`` at
+    BLOCK_ROWS-aligned vreg-row boundaries — the same cuts split the
+    bucket-order segment table, because runs never span rows — then
+    rebases each shard's run ends to shard-local slots, re-sorts each
+    shard's runs by dst (per-shard ``seg_perm``/``dst_ptr``), and pads
+    rows and runs to the mesh maximum.  Pad runs point at slot 0 with
+    the row-leading flag set, and the per-shard ``dst_ptr`` never
+    reaches them, so their garbage partials are computed but never
+    reduced into any destination.  The underlying ``plan`` is kept so
+    the node's checkpoint store persists one format for both the
+    single-device and sharded windowed backends.
+    """
+
+    mesh: Mesh
+    n: int
+    rows_per_shard: int  # BLOCK_ROWS-aligned vreg-rows per shard
+    table_entries: int  # replicated score-table padding (WINDOW multiple)
+    s_max: int  # padded per-shard run count
+    interpret: bool  # Pallas interpret mode (CPU meshes)
+    wid: jax.Array  # (n_shards*rows_per_shard,) int32, sharded
+    local: jax.Array  # (n_shards*rows_per_shard*8, 128) int32, sharded
+    weight: jax.Array  # (n_shards*rows_per_shard*8, 128) f32, sharded
+    seg_end: jax.Array  # (n_shards*s_max,) int32 shard-local, sharded
+    seg_first: jax.Array  # (n_shards*s_max,) bool, sharded
+    seg_perm: jax.Array  # (n_shards*s_max,) int32 per-shard dst order, sharded
+    dst_ptr: jax.Array  # (n_shards, n+1) int32, sharded on axis 0
+    p: jax.Array  # (n,) f32, replicated
+    dangling: jax.Array  # (n,) f32, replicated
+    plan: WindowPlan  # the single-graph plan this partitions
+
+    @classmethod
+    def build(
+        cls,
+        graph: TrustGraph,
+        mesh: Mesh,
+        *,
+        plan: WindowPlan | None = None,
+        interpret: bool | None = None,
+    ) -> "ShardedWindowPlan":
+        """Normalize the graph, reuse (or build) its ``WindowPlan``, and
+        partition it across the mesh.  A candidate ``plan`` (e.g.
+        checkpoint-restored) is revalidated by fingerprint and layout
+        version, exactly like the single-device backend."""
+        g = graph.drop_self_edges()
+        w, dangling = g.row_normalized()
+        fp = graph_fingerprint(g.n, g.src, g.dst, w)
+        if (
+            plan is None
+            or getattr(plan, "version", 0) != PLAN_VERSION
+            or plan.fingerprint != fp
+        ):
+            plan = build_window_plan(g.src, g.dst, w, n=g.n)
+
+        n_shards = mesh.shape[SHARD_AXIS]
+        rows_per_shard = -(-plan.n_rows // (n_shards * BLOCK_ROWS)) * BLOCK_ROWS
+        total_rows = n_shards * rows_per_shard
+        wid = np.zeros(total_rows, np.int32)
+        wid[: plan.n_rows] = plan.wid
+        local = np.zeros((total_rows * 8, 128), np.int32)
+        local[: plan.n_rows * 8] = plan.local
+        weight = np.zeros((total_rows * 8, 128), np.float32)
+        weight[: plan.n_rows * 8] = plan.weight
+
+        # Segment table: bucket order is slot order, so the row cuts
+        # give contiguous per-shard slices.
+        s = plan.n_segments
+        shard_of = (plan.seg_end // ROW) // rows_per_shard
+        counts = np.bincount(shard_of, minlength=n_shards)
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        s_max = max(int(counts.max()), 1)
+        # Bucket-order run destinations, recovered from the stored dst
+        # permutation (the plan keeps no explicit per-run dst array).
+        seg_dst = np.empty(s, np.int32)
+        seg_dst[plan.seg_perm] = np.repeat(
+            np.arange(plan.n, dtype=np.int32), np.diff(plan.dst_ptr)
+        )
+        seg_end = np.zeros((n_shards, s_max), np.int32)
+        seg_first = np.ones((n_shards, s_max), bool)
+        seg_perm = np.zeros((n_shards, s_max), np.int32)
+        dst_ptr = np.zeros((n_shards, plan.n + 1), np.int32)
+        for k in range(n_shards):
+            beg, end = int(offsets[k]), int(offsets[k + 1])
+            sk = end - beg
+            seg_end[k, :sk] = plan.seg_end[beg:end] - k * rows_per_shard * ROW
+            seg_first[k, :sk] = plan.seg_first[beg:end]
+            # Pad runs stay a valid permutation so XLA's gather cost is
+            # uniform; they land beyond dst_ptr[k, n] and are dropped.
+            seg_perm[k, sk:] = np.arange(sk, s_max, dtype=np.int32)
+            if sk:
+                sperm, dst_counts, _ = _counting_sort(seg_dst[beg:end], plan.n)
+                seg_perm[k, :sk] = sperm
+                np.cumsum(dst_counts, out=dst_ptr[k, 1:])
+
+        edge = NamedSharding(mesh, P(SHARD_AXIS))
+        edge2d = NamedSharding(mesh, P(SHARD_AXIS, None))
+        repl = NamedSharding(mesh, P())
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        return cls(
+            mesh=mesh,
+            n=plan.n,
+            rows_per_shard=rows_per_shard,
+            table_entries=plan.table_entries,
+            s_max=s_max,
+            interpret=bool(interpret),
+            wid=jax.device_put(wid, edge),
+            local=jax.device_put(local, edge2d),
+            weight=jax.device_put(weight, edge2d),
+            seg_end=jax.device_put(seg_end.reshape(-1), edge),
+            seg_first=jax.device_put(seg_first.reshape(-1), edge),
+            seg_perm=jax.device_put(seg_perm.reshape(-1), edge),
+            dst_ptr=jax.device_put(dst_ptr, edge2d),
+            p=jax.device_put(graph.pre_trust_vector(), repl),
+            dangling=jax.device_put(dangling.astype(np.float32), repl),
+            plan=plan,
+        )
+
+    def t0(self) -> jax.Array:
+        return self.p
+
+
+def _get_windowed_runner(
+    mesh: Mesh, n: int, rows_per_shard: int, table_entries: int, interpret: bool
+):
+    key = (mesh, n, rows_per_shard, table_entries, interpret)
+    if key in _RUN_CACHE:
+        return _RUN_CACHE[key]
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS),
+            P(SHARD_AXIS, None),
+            P(SHARD_AXIS, None),
+            P(SHARD_AXIS),
+            P(SHARD_AXIS),
+            P(SHARD_AXIS),
+            P(SHARD_AXIS, None),
+            P(),
+            P(),
+            P(),
+            P(),
+        ),
+        out_specs=P(),
+        # pallas_call has no shard_map replication rule; the step's
+        # output replication is guaranteed by the trailing psum +
+        # elementwise damping, so the static check is safely skipped.
+        check_rep=False,
+    )
+    def step(
+        wid, local, weight, seg_end, seg_first, seg_perm, dst_ptr,
+        t, p, dangling, alpha,
+    ):
+        # The identical fused step as the single-device tpu-windowed
+        # backend, over this shard's rows/runs; dst rows whose runs
+        # live on several shards are completed by the psum below.
+        partial_ct = windowed_ct(
+            wid,
+            local,
+            weight,
+            seg_end,
+            seg_first,
+            seg_perm,
+            dst_ptr[0],
+            t,
+            n_rows=rows_per_shard,
+            table_entries=table_entries,
+            interpret=interpret,
+        )
+        ct = lax.psum(partial_ct, SHARD_AXIS)
+        dangling_mass = jnp.sum(t * dangling)
+        t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
+        return t_new / jnp.sum(t_new)
+
+    @partial(jax.jit, static_argnames=("max_iter", "tol"))
+    def run(
+        wid, local, weight, seg_end, seg_first, seg_perm, dst_ptr,
+        t0, p, dangling, alpha, *, max_iter, tol,
+    ):
+        from ..ops.sparse import run_power_iteration
+
+        return run_power_iteration(
+            lambda t: step(
+                wid, local, weight, seg_end, seg_first, seg_perm, dst_ptr,
+                t, p, dangling, alpha,
+            ),
+            t0,
+            tol=tol,
+            max_iter=max_iter,
+        )
+
+    _RUN_CACHE[key] = run
+    return run
+
+
+#: Kernels selectable under ``converge_sharded`` (ManagerConfig /
+#: ProtocolConfig spell them ``tpu-sharded:<kernel>``): each value
+#: builds the mesh-resident problem whose type the dispatch below
+#: recognizes.
+SHARDED_KERNELS: dict[str, type] = {
+    "tpu-csr": ShardedTrustProblem,
+    "tpu-windowed": ShardedWindowPlan,
+}
+
+
 def converge_sharded(
-    problem: ShardedTrustProblem,
+    problem: ShardedTrustProblem | ShardedWindowPlan,
     *,
     alpha: float = 0.1,
     tol: float = 1e-6,
     max_iter: int = 50,
 ) -> tuple[jax.Array, int, float]:
-    """Damped power iteration to an L1 fixed point on the mesh.
+    """Damped power iteration to an L1 fixed point on the mesh, with
+    the kernel selected by the problem type (``SHARDED_KERNELS``):
+    ``ShardedTrustProblem`` runs the CSR/cumsum SpMV,
+    ``ShardedWindowPlan`` the fused windowed pipeline.
 
     Returns ``(t, iterations, final residual)``.  ``tol <= 0`` runs
     exactly ``max_iter`` fixed steps (benchmark mode).
     """
+    if isinstance(problem, ShardedWindowPlan):
+        run = _get_windowed_runner(
+            problem.mesh,
+            problem.n,
+            problem.rows_per_shard,
+            problem.table_entries,
+            problem.interpret,
+        )
+        t, it, resid = run(
+            problem.wid,
+            problem.local,
+            problem.weight,
+            problem.seg_end,
+            problem.seg_first,
+            problem.seg_perm,
+            problem.dst_ptr,
+            problem.t0(),
+            problem.p,
+            problem.dangling,
+            jnp.float32(alpha),
+            max_iter=max_iter,
+            tol=tol,
+        )
+        return t, int(it), float(resid)
     run = _get_runner(problem.mesh, problem.n)
     t, it, resid = run(
         problem.src,

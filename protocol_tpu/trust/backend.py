@@ -16,8 +16,13 @@ backends along the scaling ladder:
   Prefer ``tpu-csr`` when the graph churns every epoch (plan cost is
   then per-epoch), when N exceeds the VMEM table cap, or on toolchains
   where Mosaic is unavailable.
-- ``tpu-sharded``   edge-sharded SpMV + psum over a device mesh (shares
-  the CSR ``rowsum_sorted`` kernel via per-shard row pointers)
+- ``tpu-sharded``   edge-sharded convergence + psum over a device mesh.
+  Two per-shard kernels (``parallel/sharded.py::SHARDED_KERNELS``),
+  selected with a ``:<kernel>`` suffix on the backend name:
+  ``tpu-sharded:tpu-csr`` (default — per-shard CSR ``rowsum_sorted``
+  via clipped row pointers) and ``tpu-sharded:tpu-windowed`` (the fused
+  fixed-slot pipeline partitioned by window rows, PERF.md §8 — the
+  multi-chip path that keeps the 50× windowed gather).
 
 All float backends compute the damped EigenTrust fixed point over the
 row-normalized graph; ``native-cpu`` additionally reproduces the
@@ -35,6 +40,7 @@ import numpy as np
 
 from ..ops.dense import converge_dense
 from ..ops.gather_window import (
+    PLAN_VERSION,
     WindowPlan,
     build_window_plan,
     converge_windowed,
@@ -251,7 +257,11 @@ class WindowedJaxBackend(TrustBackend):
         w, dangling = g.row_normalized()
         fp = graph_fingerprint(g.n, g.src, g.dst, w)
         plan = self.plan
-        if plan is None or plan.fingerprint != fp:
+        if (
+            plan is None
+            or getattr(plan, "version", 0) != PLAN_VERSION
+            or plan.fingerprint != fp
+        ):
             plan = build_window_plan(g.src, g.dst, w, n=g.n)
             self.plan = plan
         self.last_plan = plan
@@ -282,17 +292,46 @@ class WindowedJaxBackend(TrustBackend):
 
 
 class ShardedJaxBackend(TrustBackend):
+    """Mesh-sharded convergence, kernel-selectable
+    (``parallel/sharded.py::SHARDED_KERNELS``): ``tpu-csr`` shards the
+    edge list, ``tpu-windowed`` shards the fused-pipeline window rows
+    and keeps the one-time ``WindowPlan`` cached/revalidated exactly
+    like the single-device windowed backend (``plan``/``last_plan``
+    carry it to and from the node's checkpoint store)."""
+
     name = "tpu-sharded"
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, kernel: str = "tpu-csr"):
+        from ..parallel.sharded import SHARDED_KERNELS
+
+        if kernel not in SHARDED_KERNELS:
+            raise ValueError(
+                f"unknown sharded kernel {kernel!r}; "
+                f"available: {sorted(SHARDED_KERNELS)}"
+            )
         self.mesh = mesh
+        self.kernel = kernel
+        #: Candidate WindowPlan to reuse (tpu-windowed kernel only).
+        self.plan: WindowPlan | None = None
+        #: The plan the last converge actually used (for persistence).
+        self.last_plan: WindowPlan | None = None
 
     def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
         from ..parallel.mesh import default_mesh
-        from ..parallel.sharded import ShardedTrustProblem, converge_sharded
+        from ..parallel.sharded import (
+            ShardedTrustProblem,
+            ShardedWindowPlan,
+            converge_sharded,
+        )
 
         mesh = self.mesh if self.mesh is not None else default_mesh()
-        problem = ShardedTrustProblem.build(graph, mesh)
+        problem: ShardedTrustProblem | ShardedWindowPlan
+        if self.kernel == "tpu-windowed":
+            swp = ShardedWindowPlan.build(graph, mesh, plan=self.plan)
+            self.plan = self.last_plan = swp.plan
+            problem = swp
+        else:
+            problem = ShardedTrustProblem.build(graph, mesh)
         t, it, resid = converge_sharded(
             problem, alpha=alpha, tol=tol, max_iter=max_iter
         )
@@ -300,7 +339,7 @@ class ShardedJaxBackend(TrustBackend):
             scores=np.asarray(t, dtype=np.float64),
             iterations=it,
             residual=resid,
-            backend=self.name,
+            backend=self.name if self.kernel == "tpu-csr" else f"{self.name}:{self.kernel}",
         )
 
 
@@ -315,8 +354,20 @@ _BACKENDS = {
 
 
 def get_backend(name: str, **kwargs) -> TrustBackend:
+    """Construct a backend by ladder name.  ``tpu-sharded`` accepts a
+    per-shard kernel suffix — ``tpu-sharded:tpu-windowed`` — so config
+    strings (ManagerConfig.backend / ProtocolConfig.trust_backend) can
+    select the sharded kernel without code."""
+    base, _, kernel = name.partition(":")
+    if kernel:
+        if base != "tpu-sharded":
+            raise ValueError(
+                f"unknown trust backend {name!r}; only tpu-sharded takes a "
+                f":<kernel> suffix (available: {sorted(_BACKENDS)})"
+            )
+        kwargs.setdefault("kernel", kernel)
     try:
-        return _BACKENDS[name](**kwargs)
+        return _BACKENDS[base](**kwargs)
     except KeyError:
         raise ValueError(
             f"unknown trust backend {name!r}; available: {sorted(_BACKENDS)}"

@@ -8,10 +8,11 @@ cd "$(dirname "$0")/.."
 
 python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.py
 
-# Trees held to the hard format/type gates: the convergence-kernel and
-# backend code the fused-pipeline work (PERF.md §7) touches.  The rest
-# of the tree stays informational until it is brought up to the wall.
-HARD_TREES="protocol_tpu/ops protocol_tpu/trust"
+# Trees held to the hard format/type gates: the convergence-kernel,
+# backend, mesh-parallel, and node code the fused-pipeline work
+# (PERF.md §7-8) touches.  The rest of the tree stays informational
+# until it is brought up to the wall.
+HARD_TREES="protocol_tpu/ops protocol_tpu/trust protocol_tpu/parallel protocol_tpu/node"
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check .

@@ -1,0 +1,167 @@
+"""JSON-RPC transport fixture for ``_Web3Rpc`` (node/ethereum.py) —
+the last no-cover transport path, exercised without web3 in the image
+(VERDICT item #10).
+
+A recorded ``eth_getLogs`` / ``eth_blockNumber`` response (hex-string
+wire shape, as JSON-RPC returns it) is served through a stub ``web3``
+module that mimics web3.py's response normalization (HexBytes — a bytes
+subclass — for topics and data).  The tests cover exactly what the real
+transport must get right: the HexBytes→int topic normalization in
+``_Web3Rpc._Log``, the get_logs query shape (fromBlock/toBlock/address
+checksum/topic filter), block_number, and the decode path shared with
+every other RPC backend.
+"""
+
+import sys
+import types
+
+import pytest
+
+from protocol_tpu.node.ethereum import (
+    ATTESTATION_CREATED_TOPIC,
+    Web3EventSource,
+    _Web3Rpc,
+    have_web3,
+)
+
+CONTRACT = "0x" + "ab" * 20
+CREATOR = 0x1234567890ABCDEF1234567890ABCDEF12345678
+ABOUT = 0xFEDCBA0987654321FEDCBA0987654321FEDCBA09
+KEY = bytes.fromhex("05" * 32)
+VAL = bytes(range(96))  # 5-neighbour attestation payloads are ~this size
+
+
+def _abi_dynamic_bytes(val: bytes) -> bytes:
+    """ABI encoding of one dynamic ``bytes`` argument: offset word,
+    length word, payload padded to a 32-byte boundary."""
+    pad = (-len(val)) % 32
+    return (
+        (32).to_bytes(32, "big") + len(val).to_bytes(32, "big") + val + b"\x00" * pad
+    )
+
+
+#: The recorded JSON-RPC responses, in wire shape (lowercase hex
+#: strings) — what an ``eth_getLogs`` result entry for one
+#: AttestationCreated event and an ``eth_blockNumber`` call look like.
+RECORDED = {
+    "eth_blockNumber": "0x10",
+    "eth_getLogs": [
+        {
+            "topics": [
+                ATTESTATION_CREATED_TOPIC,
+                "0x" + f"{CREATOR:064x}",
+                "0x" + f"{ABOUT:064x}",
+                "0x" + KEY.hex(),
+            ],
+            "data": "0x" + _abi_dynamic_bytes(VAL).hex(),
+        }
+    ],
+}
+
+
+class _HexBytes(bytes):
+    """web3.py returns HexBytes (a bytes subclass) for topics/data."""
+
+
+def _fake_web3_module(recorded: dict, queries: list) -> types.ModuleType:
+    """A web3 stub replaying the recorded responses: hex-string wire
+    values are normalized to HexBytes exactly like web3.py does, and
+    every get_logs query is captured for shape assertions."""
+
+    class _Eth:
+        @property
+        def block_number(self):
+            return int(recorded["eth_blockNumber"], 16)
+
+        def get_logs(self, query):
+            queries.append(dict(query))
+            return [
+                {
+                    "topics": [
+                        _HexBytes(bytes.fromhex(t[2:])) for t in log["topics"]
+                    ],
+                    "data": _HexBytes(bytes.fromhex(log["data"][2:])),
+                }
+                for log in recorded["eth_getLogs"]
+            ]
+
+    class Web3:
+        class HTTPProvider:
+            def __init__(self, url):
+                self.url = url
+
+        def __init__(self, provider):
+            self.provider = provider
+            self.eth = _Eth()
+
+        @staticmethod
+        def to_checksum_address(addr):
+            # EIP-55 casing is cosmetic for the stub; byte identity is
+            # what the query-shape assertions check.
+            return addr
+
+    mod = types.ModuleType("web3")
+    mod.Web3 = Web3
+    return mod
+
+
+@pytest.fixture
+def rpc_fixture(monkeypatch):
+    queries: list = []
+    monkeypatch.setitem(sys.modules, "web3", _fake_web3_module(RECORDED, queries))
+    return queries
+
+
+class TestWeb3RpcFixture:
+    def test_replay_decodes_recorded_logs(self, rpc_fixture):
+        source = Web3EventSource("http://node:8545", CONTRACT)
+        events = list(source.replay(from_block=0))
+        assert len(events) == 1
+        ev = events[0]
+        assert ev.creator == f"0x{CREATOR:040x}"
+        assert ev.about == f"0x{ABOUT:040x}"
+        assert ev.key == KEY
+        assert ev.val == VAL
+
+    def test_get_logs_query_shape(self, rpc_fixture):
+        source = Web3EventSource("http://node:8545", CONTRACT)
+        list(source.replay(from_block=7, to_block=12))
+        (query,) = rpc_fixture
+        assert query["fromBlock"] == 7
+        assert query["toBlock"] == 12
+        assert query["address"] == CONTRACT
+        # One-element topic filter pinned to the AttestationCreated
+        # topic0 — anything broader would replay foreign events.
+        assert query["topics"] == [ATTESTATION_CREATED_TOPIC]
+
+    def test_open_ended_replay_omits_to_block(self, rpc_fixture):
+        source = Web3EventSource("http://node:8545", CONTRACT)
+        list(source.replay(from_block=0))
+        (query,) = rpc_fixture
+        assert "toBlock" not in query
+
+    def test_block_number_normalizes(self, rpc_fixture):
+        rpc = _Web3Rpc("http://node:8545")
+        assert rpc.block_number() == 16
+
+    def test_log_topic_normalization(self, rpc_fixture):
+        """web3's HexBytes topics become plain ints on the _Log shim —
+        the contract ChainEventSource._decode relies on."""
+        rpc = _Web3Rpc("http://node:8545")
+        logs = rpc.get_logs(
+            address=int(CONTRACT, 16),
+            from_block=0,
+            to_block=None,
+            topic0=int(ATTESTATION_CREATED_TOPIC, 16),
+        )
+        (log,) = logs
+        assert all(isinstance(t, int) for t in log.topics)
+        assert log.topics[0] == int(ATTESTATION_CREATED_TOPIC, 16)
+        assert log.topics[1] == CREATOR
+        assert isinstance(log.data, bytes)
+
+    def test_without_web3_raises_actionable_error(self):
+        if have_web3():  # pragma: no cover - image carries no web3
+            pytest.skip("real web3 installed; the gated path is live")
+        with pytest.raises(RuntimeError, match="web3.py is not installed"):
+            Web3EventSource("http://node:8545", CONTRACT)

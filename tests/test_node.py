@@ -186,6 +186,20 @@ class TestManager:
         # Symmetric uniform graph → uniform trust.
         np.testing.assert_allclose(res.scores, [1 / NUM_NEIGHBOURS] * NUM_NEIGHBOURS, rtol=1e-4)
 
+    def test_window_plan_cached_across_epochs(self):
+        """Both windowed backends (single-device and sharded) surface
+        their WindowPlan through the manager cache, so stable graphs
+        build it once and checkpoints can persist it."""
+        for backend in ("tpu-windowed", "tpu-sharded:tpu-windowed"):
+            m = Manager(ManagerConfig(backend=backend, prover="commitment"))
+            m.generate_initial_attestations()
+            res1 = m.converge_epoch(Epoch(1), alpha=0.1)
+            assert m.window_plan is not None, backend
+            plan = m.window_plan
+            res2 = m.converge_epoch(Epoch(2), alpha=0.1)
+            assert m.window_plan is plan, backend  # fingerprint hit
+            np.testing.assert_allclose(res1.scores, res2.scores, rtol=1e-6)
+
 
 class TestHandleRequest:
     def _ready_manager(self):
