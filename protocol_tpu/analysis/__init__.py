@@ -1,0 +1,45 @@
+"""protocol_tpu.analysis — graftlint, the two-pass static analyzer.
+
+The invariants that make the trust backends fast — one random gather
+per windowed step, streaming boundary reads, no f64 upcasts, no host
+callbacks inside the jit'd loop, one psum under shard_map — are
+contracts of the *lowered* computation, invisible to ruff and mypy.
+This subsystem checks them by machine:
+
+- **Pass 1** (``invariants``): trace every registered backend's step
+  function to a closed jaxpr on a synthetic graph and check the
+  declarative :data:`~protocol_tpu.analysis.budget.KERNEL_INVARIANTS`
+  budgets declared next to each kernel.
+- **Pass 2** (``ast_rules``): an ``ast.NodeVisitor`` ruleset over
+  ``protocol_tpu/`` catching implicit host syncs and import-time
+  device work.
+
+Run as ``python -m protocol_tpu.analysis``: emits ``ANALYSIS.json``
+plus ``file:line`` findings; any error-severity finding exits non-zero
+(``scripts/lint.sh`` and CI treat it as a hard gate).  PERF.md §9
+documents the pinned invariants and how to declare one for a new
+backend.
+
+This ``__init__`` stays dependency-light (the kernel modules import
+``.budget`` at their own import time); the tracing passes load jax
+only when invoked.
+"""
+
+from .budget import (
+    KERNEL_INVARIANTS,
+    NON_JAX_BACKENDS,
+    GatherBudget,
+    KernelBudget,
+    declare,
+)
+from .report import Finding, Report
+
+__all__ = [
+    "Finding",
+    "GatherBudget",
+    "KERNEL_INVARIANTS",
+    "KernelBudget",
+    "NON_JAX_BACKENDS",
+    "Report",
+    "declare",
+]

@@ -1,0 +1,102 @@
+"""CLI: ``python -m protocol_tpu.analysis`` — run graftlint.
+
+Exit code 0 iff no error-severity finding; writes ``ANALYSIS.json``
+(CI uploads it as a build artifact).  ``--fixture`` runs one seeded
+violation instead of the real tree — it must exit non-zero, which
+doubles as the gate's self-check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _ensure_cpu_mesh() -> None:
+    """Force the 8-device virtual CPU mesh before jax's backend
+    initializes (same doctrine as tests/conftest.py): the sharded
+    composites trace under a real Mesh without TPU hardware."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m protocol_tpu.analysis",
+        description="graftlint: jaxpr/AST invariant analyzer for the trust backends",
+    )
+    parser.add_argument(
+        "--output",
+        default="ANALYSIS.json",
+        help="machine-readable report path (default: %(default)s)",
+    )
+    parser.add_argument(
+        "--pass",
+        dest="passes",
+        choices=("all", "jaxpr", "ast"),
+        default="all",
+        help="which pass(es) to run (default: %(default)s)",
+    )
+    parser.add_argument(
+        "--fixture",
+        default=None,
+        help="run one seeded violation fixture instead of the real tree",
+    )
+    parser.add_argument(
+        "--list-fixtures", action="store_true", help="list fixture names and exit"
+    )
+    args = parser.parse_args(argv)
+
+    _ensure_cpu_mesh()
+    from .report import Report
+
+    report = Report()
+
+    if args.list_fixtures:
+        from .fixtures import FIXTURES
+
+        for name, fixture in sorted(FIXTURES.items()):
+            print(f"{name}: expects {fixture.rule}")
+        return 0
+
+    if args.fixture is not None:
+        from .fixtures import FIXTURES, run_fixture
+
+        if args.fixture not in FIXTURES:
+            print(
+                f"unknown fixture {args.fixture!r}; "
+                f"available: {', '.join(sorted(FIXTURES))}",
+                file=sys.stderr,
+            )
+            return 2
+        report.extend(run_fixture(args.fixture))
+        report.backends[f"fixture:{args.fixture}"] = {"status": "fixture"}
+    else:
+        if args.passes in ("all", "jaxpr"):
+            from .invariants import run_jaxpr_pass
+
+            findings, meta = run_jaxpr_pass()
+            report.extend(findings)
+            report.backends.update(meta)
+        if args.passes in ("all", "ast"):
+            from .ast_rules import run_ast_pass
+
+            findings, n_files = run_ast_pass()
+            report.extend(findings)
+            report.files_scanned = n_files
+
+    report.write_json(args.output)
+    print(report.render())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,234 @@
+"""Pass 2 — the AST repo-lint: hazards mypy/ruff don't model.
+
+Three rules over ``protocol_tpu/``, each an implicit-host-sync or
+import-cost hazard the jaxpr pass can't see (it only traces registered
+backends):
+
+- ``host-op-in-jit`` (error): ``np.asarray``/``np.array``, ``.item()``,
+  or ``float()``/``int()`` on a non-literal applied inside a
+  ``@jax.jit``-decorated function.  On a traced value these force a
+  host round-trip per call (or a tracer error at a distance); static
+  shape math belongs outside the jit boundary.
+- ``import-time-jnp`` (error, hot trees only): ``jnp.*`` array
+  construction at module scope in ``ops/``, ``trust/``, ``parallel/``,
+  ``node/`` — it initializes the device backend (and possibly a TPU
+  runtime grab) as an import side effect.
+- ``bare-sync`` (error): a bare ``jax.device_get(...)`` or
+  ``x.block_until_ready()`` expression statement whose result is
+  discarded — a synchronization point that belongs in ``bench/`` or
+  ``tests/``, not in library code.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .report import Finding
+
+#: Trees where import-time device work is a hard error (the modules the
+#: node imports on its boot path).
+HOT_TREES = ("ops", "trust", "parallel", "node")
+
+#: jnp attributes that are plain dtypes/constants, not array factories.
+_JNP_DTYPE_NAMES = frozenset(
+    {
+        "bfloat16",
+        "bool_",
+        "complex64",
+        "complex128",
+        "dtype",
+        "finfo",
+        "float16",
+        "float32",
+        "float64",
+        "iinfo",
+        "inf",
+        "int8",
+        "int16",
+        "int32",
+        "int64",
+        "nan",
+        "newaxis",
+        "pi",
+        "uint8",
+        "uint16",
+        "uint32",
+        "uint64",
+    }
+)
+
+_NUMPY_ALIASES = frozenset({"np", "numpy"})
+_JNP_ALIASES = frozenset({"jnp"})
+
+
+def _dotted(node: ast.expr) -> str | None:
+    """``a.b.c`` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """True for ``@jit``, ``@jax.jit``, ``@partial(jax.jit, ...)``,
+    ``@functools.partial(jax.jit, ...)``, and ``@jax.jit(...)``."""
+    if isinstance(dec, ast.Call):
+        name = _dotted(dec.func)
+        if name in ("partial", "functools.partial") and dec.args:
+            return _dotted(dec.args[0]) in ("jit", "jax.jit")
+        return name in ("jit", "jax.jit")
+    return _dotted(dec) in ("jit", "jax.jit")
+
+
+def _is_literal(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant) or (
+        isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant)
+    )
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel_path: str, hot: bool) -> None:
+        self.rel_path = rel_path
+        self.hot = hot
+        self.jit_depth = 0
+        self.fn_depth = 0
+        self.findings: list[Finding] = []
+
+    def _emit(self, rule: str, message: str, node: ast.AST) -> None:
+        self.findings.append(
+            Finding(
+                pass_name="ast",
+                rule=rule,
+                severity="error",
+                message=message,
+                file=self.rel_path,
+                line=getattr(node, "lineno", None),
+            )
+        )
+
+    # -- function scope tracking ---------------------------------------
+
+    def _visit_function(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        jitted = any(_is_jit_decorator(d) for d in node.decorator_list)
+        self.fn_depth += 1
+        self.jit_depth += 1 if jitted else 0
+        self.generic_visit(node)
+        self.jit_depth -= 1 if jitted else 0
+        self.fn_depth -= 1
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # -- rules ----------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        if self.jit_depth > 0:
+            if name is not None:
+                root = name.split(".", 1)[0]
+                if root in _NUMPY_ALIASES:
+                    self._emit(
+                        "host-op-in-jit",
+                        f"{name}() inside a @jit function materializes "
+                        "traced values on the host",
+                        node,
+                    )
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+            ):
+                self._emit(
+                    "host-op-in-jit",
+                    ".item() inside a @jit function forces a host sync",
+                    node,
+                )
+            if (
+                name in ("float", "int")
+                and node.args
+                and not _is_literal(node.args[0])
+            ):
+                self._emit(
+                    "host-op-in-jit",
+                    f"{name}() on a non-literal inside a @jit function "
+                    "concretizes a traced value",
+                    node,
+                )
+        if (
+            self.fn_depth == 0
+            and self.hot
+            and name is not None
+            and name.split(".", 1)[0] in _JNP_ALIASES
+        ):
+            attr = name.split(".", 1)[1] if "." in name else ""
+            if attr not in _JNP_DTYPE_NAMES:
+                self._emit(
+                    "import-time-jnp",
+                    f"{name}() at module import time in a hot module "
+                    "initializes the device backend as an import side "
+                    "effect",
+                    node,
+                )
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        if isinstance(node.value, ast.Call):
+            name = _dotted(node.value.func)
+            bare_sync = name == "jax.device_get" or (
+                isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "block_until_ready"
+            )
+            if bare_sync:
+                self._emit(
+                    "bare-sync",
+                    "bare device sync (result discarded) outside bench/ "
+                    "and tests/",
+                    node,
+                )
+        self.generic_visit(node)
+
+
+def _is_hot(rel_path: str) -> bool:
+    parts = Path(rel_path).parts
+    return len(parts) >= 2 and parts[0] == "protocol_tpu" and parts[1] in HOT_TREES
+
+
+def scan_file(path: Path, root: Path) -> list[Finding]:
+    rel = str(path.relative_to(root))
+    try:
+        tree = ast.parse(path.read_text(), filename=rel)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                pass_name="ast",
+                rule="syntax-error",
+                severity="error",
+                message=str(exc),
+                file=rel,
+                line=exc.lineno,
+            )
+        ]
+    visitor = _Visitor(rel, hot=_is_hot(rel))
+    visitor.visit(tree)
+    return visitor.findings
+
+
+def run_ast_pass(root: str | Path | None = None) -> tuple[list[Finding], int]:
+    """Scan ``protocol_tpu/`` under ``root`` (default: the repo this
+    package was imported from).  Returns ``(findings, files_scanned)``."""
+    if root is None:
+        root = Path(__file__).resolve().parent.parent.parent
+    root = Path(root)
+    findings: list[Finding] = []
+    files = sorted((root / "protocol_tpu").rglob("*.py"))
+    for path in files:
+        findings.extend(scan_file(path, root))
+    return findings, len(files)
+
+
+__all__ = ["HOT_TREES", "run_ast_pass", "scan_file"]

@@ -1,0 +1,115 @@
+"""Declarative per-backend kernel budgets — the ``KERNEL_INVARIANTS`` table.
+
+Every trust backend's fast path rests on invariants of its *lowered*
+computation that neither the type system nor the test assertions see:
+how many random gathers one power step performs, that the boundary read
+streams (``indices_are_sorted``), that nothing upcasts to f64 or calls
+back to the host inside the jit'd loop.  "Analysis of Power Iteration
+Algorithm with Partially Observed Matrix-vector Products" (PAPERS.md)
+makes the underlying point precise: the convergence claims hold for a
+specific per-iteration access pattern, so the access pattern is part of
+the kernel's contract.
+
+The budgets are *declared next to the kernels they pin* — each kernel
+module calls :func:`declare` at import time — and *checked* by
+``protocol_tpu.analysis.invariants``, which traces each backend's step
+function to a closed jaxpr and walks it.  Adding a backend to the
+``trust/backend.py`` registry without declaring its budget is itself a
+lint error (``undeclared-backend``), so every future backend inherits
+the gate for free.
+
+This module is a dependency leaf: the kernel modules import it, so it
+must not import jax, numpy, or anything else from ``protocol_tpu``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class GatherBudget:
+    """Budget for the gathers of one named size class.
+
+    ``dim`` names a dimension the trace recipe reports (e.g.
+    ``n_segments``); every gather whose leading output dimension equals
+    that size is counted against this budget.  ``boundary_sorted``
+    additionally requires exactly one ``(dim, 2)``-shaped gather marked
+    ``indices_are_sorted`` + ``unique_indices`` — the streaming
+    two-lane boundary read of the single-pass bridge (PERF.md §8).
+    """
+
+    dim: str
+    max_total: int
+    max_random: int
+    boundary_sorted: bool = False
+
+
+@dataclass(frozen=True)
+class KernelBudget:
+    """The per-backend invariant contract checked by pass 1.
+
+    Counting conventions: gathers/scatters inside a ``pallas_call``
+    body are excluded (interpret-mode bodies re-express the Mosaic
+    kernel as XLA ops; on the real chip they are not XLA gathers), and
+    a "random" gather is one not marked ``indices_are_sorted``.
+    """
+
+    backend: str
+    #: Max gathers without ``indices_are_sorted`` per step (all sizes).
+    max_random_gathers: int
+    #: Max scatter-family ops per step (``scatter``/``scatter-add``/...).
+    max_scatters: int = 0
+    #: f64 avals permitted anywhere in the step jaxpr.
+    allow_f64: bool = False
+    #: Exact number of ``psum``/``psum2`` collectives per step; any
+    #: psum present must sit under a ``shard_map``.
+    psum_count: int = 0
+    #: Primitives that must appear somewhere in the step (e.g.
+    #: ``dot_general`` for the MXU path, ``pallas_call`` for windowed).
+    require_primitives: tuple[str, ...] = ()
+    #: Size-classed gather budgets (see :class:`GatherBudget`).
+    gather_budgets: tuple[GatherBudget, ...] = ()
+    #: Converge-function arguments declared donated; the analyzer
+    #: verifies the aliasing materialized in the lowered computation.
+    donated_args: tuple[str, ...] = ()
+    #: Free-form rationale recorded in ANALYSIS.json.
+    notes: str = ""
+
+    @property
+    def invariant_count(self) -> int:
+        """How many distinct invariants checking this budget evaluates
+        (the acceptance floor is >= 3 per registered backend)."""
+        n = 4  # random-gather, scatter, f64, callback checks always run
+        n += 1  # psum count/placement is always asserted (incl. == 0)
+        n += len(self.require_primitives)
+        for gb in self.gather_budgets:
+            n += 2 + (1 if gb.boundary_sorted else 0)
+        if self.donated_args:
+            n += 1
+        return n
+
+
+#: Backends that never enter jax — exempt from jaxpr analysis.
+NON_JAX_BACKENDS = frozenset({"native-cpu"})
+
+#: The table: backend name -> declared budget.  Populated by kernel
+#: modules at import; read by ``protocol_tpu.analysis.invariants`` and
+#: cross-checked against the ``trust/backend.py`` registry.
+KERNEL_INVARIANTS: dict[str, KernelBudget] = {}
+
+
+def declare(budget: KernelBudget) -> KernelBudget:
+    """Register a kernel budget (idempotent per backend name; kernel
+    modules call this at import time, next to the kernel they pin)."""
+    KERNEL_INVARIANTS[budget.backend] = budget
+    return budget
+
+
+__all__ = [
+    "GatherBudget",
+    "KernelBudget",
+    "KERNEL_INVARIANTS",
+    "NON_JAX_BACKENDS",
+    "declare",
+]

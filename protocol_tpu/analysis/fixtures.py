@@ -1,0 +1,185 @@
+"""Seeded violation fixtures — kernels that deliberately break one
+invariant each, so the analyzer itself is testable.
+
+Every fixture pairs a tiny step function with a budget it violates;
+``run_fixture`` traces and checks it exactly like a real backend, and
+``tests/test_analysis.py`` asserts the right rule fires with the right
+``file:line`` (the violating lines carry ``# VIOLATION: <name>``
+markers the test resolves against this file).  The CLI exposes them as
+``python -m protocol_tpu.analysis --fixture <name>`` (exits non-zero),
+which doubles as a self-check that the gate can actually fail.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from .budget import GatherBudget, KernelBudget
+from .invariants import TraceCase, check_case
+from .report import Finding
+
+
+@dataclass(frozen=True)
+class Fixture:
+    name: str
+    rule: str  # the finding rule this fixture must trigger
+    build: Callable[[], tuple[KernelBudget, TraceCase]]
+    #: Marker suffix of the ``# VIOLATION:`` comment anchoring the
+    #: expected finding line; None when the finding has no source site.
+    marker: str | None
+
+
+def _extra_gather() -> tuple[KernelBudget, TraceCase]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t = jnp.asarray(np.arange(8, dtype=np.float32))
+    idx = jnp.asarray(np.array([3, 1, 2], np.int32))
+
+    def step(t, idx):
+        a = t[idx]
+        b = t[idx + 1]  # VIOLATION: extra-gather
+        return a + b
+
+    jaxpr = jax.make_jaxpr(step)(t, idx)
+    budget = KernelBudget(backend="fixture:extra-gather", max_random_gathers=1)
+    return budget, TraceCase("fixture:extra-gather", jaxpr)
+
+
+def _f64_leak() -> tuple[KernelBudget, TraceCase]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import enable_x64
+
+    def step(t):
+        wide = t.astype(jnp.float64)  # VIOLATION: f64-leak
+        return wide * 2.0
+
+    with enable_x64():
+        jaxpr = jax.make_jaxpr(step)(np.ones(4, np.float32))
+    budget = KernelBudget(backend="fixture:f64-leak", max_random_gathers=0)
+    return budget, TraceCase("fixture:f64-leak", jaxpr)
+
+
+def _callback_in_jit() -> tuple[KernelBudget, TraceCase]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def host_sum(x):
+        return np.float32(np.asarray(x).sum())
+
+    def step(t):
+        out = jax.ShapeDtypeStruct((), jnp.float32)
+        s = jax.pure_callback(host_sum, out, t)  # VIOLATION: callback-in-jit
+        return t * s
+
+    jaxpr = jax.make_jaxpr(step)(jnp.ones(4, jnp.float32))
+    budget = KernelBudget(backend="fixture:callback-in-jit", max_random_gathers=0)
+    return budget, TraceCase("fixture:callback-in-jit", jaxpr)
+
+
+def _unsorted_boundary() -> tuple[KernelBudget, TraceCase]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    hi = jnp.asarray(np.arange(32, dtype=np.float32))
+    seg_end = jnp.asarray(np.array([3, 7, 12, 19, 25, 31], np.int32))
+
+    def step(hi, seg_end):
+        cum2 = jnp.stack([hi, hi], axis=-1)
+        # The bridge's boundary read without the streaming declaration
+        # (indices_are_sorted/unique_indices) — XLA plans a random read.
+        ends = cum2[seg_end]  # VIOLATION: unsorted-boundary
+        return ends[:, 0] + ends[:, 1]
+
+    jaxpr = jax.make_jaxpr(step)(hi, seg_end)
+    budget = KernelBudget(
+        backend="fixture:unsorted-boundary",
+        max_random_gathers=4,
+        gather_budgets=(
+            GatherBudget(dim="n_segments", max_total=4, max_random=4, boundary_sorted=True),
+        ),
+    )
+    return budget, TraceCase(
+        "fixture:unsorted-boundary", jaxpr, dims={"n_segments": 6}
+    )
+
+
+def _scatter_in_step() -> tuple[KernelBudget, TraceCase]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t = jnp.asarray(np.ones(4, np.float32))
+    idx = jnp.asarray(np.array([2, 0, 3, 1], np.int32))
+
+    def step(t, idx):
+        return jnp.zeros(8, jnp.float32).at[idx].add(t)  # VIOLATION: scatter-in-step
+
+    jaxpr = jax.make_jaxpr(step)(t, idx)
+    budget = KernelBudget(
+        backend="fixture:scatter-in-step", max_random_gathers=4, max_scatters=0
+    )
+    return budget, TraceCase("fixture:scatter-in-step", jaxpr)
+
+
+def _missing_donation() -> tuple[KernelBudget, TraceCase]:
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit  # declares no donate_argnames — the aliasing never lowers
+    def undonated(t0):
+        return t0 * 2.0
+
+    arg = jnp.ones(4, jnp.float32)
+    jaxpr = jax.make_jaxpr(undonated)(arg)
+    budget = KernelBudget(
+        backend="fixture:missing-donation",
+        max_random_gathers=0,
+        donated_args=("t0",),
+    )
+    return budget, TraceCase(
+        "fixture:missing-donation",
+        jaxpr,
+        lowered_text=undonated.lower(arg).as_text(),
+    )
+
+
+FIXTURES: dict[str, Fixture] = {
+    f.name: f
+    for f in (
+        Fixture("extra-gather", "gather-budget", _extra_gather, "extra-gather"),
+        Fixture("f64-leak", "f64-dtype", _f64_leak, "f64-leak"),
+        Fixture(
+            "callback-in-jit", "callback-in-jit", _callback_in_jit, "callback-in-jit"
+        ),
+        Fixture(
+            "unsorted-boundary",
+            "boundary-sorted",
+            _unsorted_boundary,
+            "unsorted-boundary",
+        ),
+        Fixture(
+            "scatter-in-step", "scatter-budget", _scatter_in_step, "scatter-in-step"
+        ),
+        Fixture(
+            "missing-donation", "donation-not-materialized", _missing_donation, None
+        ),
+    )
+}
+
+
+def run_fixture(name: str) -> list[Finding]:
+    """Trace and check one seeded violation; raises KeyError on an
+    unknown name (the CLI lists valid ones)."""
+    fixture = FIXTURES[name]
+    budget, case = fixture.build()
+    return check_case(budget, case)
+
+
+__all__ = ["FIXTURES", "Fixture", "run_fixture"]

@@ -1,0 +1,110 @@
+"""Findings and the machine-readable ``ANALYSIS.json`` report.
+
+Both analyzer passes emit :class:`Finding` records with ``file:line``
+anchors; :class:`Report` aggregates them, renders the human summary,
+and serializes the JSON artifact CI uploads.  Exit-code policy: any
+``error``-severity finding fails the gate (``scripts/lint.sh``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding, anchored to source where possible."""
+
+    pass_name: str  # "jaxpr" | "ast"
+    rule: str  # stable rule id, e.g. "random-gather-budget"
+    severity: str  # "error" | "warning" | "info"
+    message: str
+    file: str | None = None
+    line: int | None = None
+    backend: str | None = None
+
+    def __post_init__(self) -> None:
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+    @property
+    def anchor(self) -> str:
+        if self.file is None:
+            return "<no source>"
+        return f"{self.file}:{self.line}" if self.line else self.file
+
+    def render(self) -> str:
+        who = f" [{self.backend}]" if self.backend else ""
+        return f"{self.severity}: {self.anchor}{who} {self.rule}: {self.message}"
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "pass": self.pass_name,
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+            "file": self.file,
+            "line": self.line,
+            "backend": self.backend,
+        }
+
+
+@dataclass
+class Report:
+    """Aggregated two-pass analysis result."""
+
+    findings: list[Finding] = field(default_factory=list)
+    #: Per-backend bookkeeping from pass 1: declared budget summary and
+    #: how many invariants were actually evaluated.
+    backends: dict[str, dict[str, Any]] = field(default_factory=dict)
+    #: Files scanned by pass 2.
+    files_scanned: int = 0
+
+    def extend(self, findings: list[Finding]) -> None:
+        self.findings.extend(findings)
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.errors else 0
+
+    def to_dict(self) -> dict[str, Any]:
+        sev = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            sev[f.severity] += 1
+        return {
+            "version": 1,
+            "tool": "protocol_tpu.analysis (graftlint)",
+            "summary": {
+                **sev,
+                "backends_checked": len(self.backends),
+                "files_scanned": self.files_scanned,
+            },
+            "backends": self.backends,
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def write_json(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self.to_dict(), indent=2) + "\n")
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        sev = {s: sum(1 for f in self.findings if f.severity == s) for s in SEVERITIES}
+        lines.append(
+            f"analysis: {len(self.backends)} backends / "
+            f"{self.files_scanned} files scanned — "
+            f"{sev['error']} error(s), {sev['warning']} warning(s), "
+            f"{sev['info']} info"
+        )
+        return "\n".join(lines)
+
+
+__all__ = ["Finding", "Report", "SEVERITIES"]

@@ -45,7 +45,11 @@ class SecretKey:
 
     @classmethod
     def random(cls, rng=secrets) -> "SecretKey":
-        a = rng.randbelow(field.MODULUS) if hasattr(rng, "randbelow") else rng.randrange(field.MODULUS)
+        a = (
+            rng.randbelow(field.MODULUS)
+            if hasattr(rng, "randbelow")
+            else rng.randrange(field.MODULUS)
+        )
         h = blake512(field.to_le_bytes(a))
         return cls(field.from_wide_bytes(h[:32]), field.from_wide_bytes(h[32:]))
 

@@ -38,7 +38,11 @@ def _keccak_f(state: list[int]) -> None:
     for rc in _ROUND_CONSTANTS:
         # theta
         c = [
-            state[x * 5] ^ state[x * 5 + 1] ^ state[x * 5 + 2] ^ state[x * 5 + 3] ^ state[x * 5 + 4]
+            state[x * 5]
+            ^ state[x * 5 + 1]
+            ^ state[x * 5 + 2]
+            ^ state[x * 5 + 3]
+            ^ state[x * 5 + 4]
             for x in range(5)
         ]
         d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
@@ -66,7 +70,11 @@ def keccak256(data: bytes) -> bytes:
 
     # Pad: 0x01 ... 0x80 (multi-rate padding with Keccak domain bit).
     pad_len = rate - (len(data) % rate)
-    padded = data + b"\x01" + b"\x00" * (pad_len - 2) + b"\x80" if pad_len >= 2 else data + b"\x81"
+    padded = (
+        data + b"\x01" + b"\x00" * (pad_len - 2) + b"\x80"
+        if pad_len >= 2
+        else data + b"\x81"
+    )
 
     for off in range(0, len(padded), rate):
         block = padded[off : off + rate]

@@ -14,10 +14,12 @@ Rebuild of server/src/manager/mod.rs:72-237.  Differences by design:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..analysis.budget import KERNEL_INVARIANTS, NON_JAX_BACKENDS
 from ..crypto import calculate_message_hash, field
 from ..crypto.eddsa import PublicKey, sign, verify as verify_sig
 from ..ops.gather_window import WindowPlan
@@ -29,6 +31,8 @@ from .attestation import Attestation
 from .bootstrap import FIXED_SET, INITIAL_SCORE, NUM_ITER, NUM_NEIGHBOURS, SCALE, keyset_from_raw
 from .epoch import Epoch
 from .errors import EigenError
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -287,6 +291,22 @@ class Manager:
         persist exactly the graph the scores belong to."""
         graph = self.build_graph()
         backend = get_backend(self.config.backend)
+        # The analyzer (`python -m protocol_tpu.analysis`) hard-gates
+        # every backend in KERNEL_INVARIANTS; a configured backend
+        # outside the table runs with its access pattern unpinned —
+        # legal (constructing it above proved it's registered) but
+        # worth a loud note in the node log.
+        key = (
+            "tpu-sharded:tpu-csr"
+            if self.config.backend == "tpu-sharded"
+            else self.config.backend
+        )
+        if key not in NON_JAX_BACKENDS and key not in KERNEL_INVARIANTS:
+            logger.warning(
+                "trust backend %r has no KERNEL_INVARIANTS declaration; "
+                "its kernel access pattern is not lint-gated (PERF.md §9)",
+                self.config.backend,
+            )
         # Plan-carrying backends (tpu-windowed, tpu-sharded:tpu-windowed)
         # expose plan/last_plan; seed from the manager's cache and keep
         # whatever the converge actually used, so checkpoints persist it.

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..analysis.budget import KernelBudget, declare
+
 
 @partial(jax.jit, static_argnames=("num_iter",))
 def converge_dense(ops_t: jax.Array, s0: jax.Array, num_iter: int) -> jax.Array:
@@ -96,3 +98,22 @@ def set_converge_dense(
 
     s, _ = lax.scan(step, s0, None, length=num_iter)
     return s * total
+
+
+# ---------------------------------------------------------------------------
+# Pinned kernel invariants (PERF.md §9) — checked per step by
+# `python -m protocol_tpu.analysis` against the traced jaxpr.
+# ---------------------------------------------------------------------------
+
+#: Dense power iteration: pure MXU matmuls — no gather, no scatter, no
+#: collective; ``dot_general`` must survive any rewrite (losing it
+#: means the contraction fell off the MXU).
+declare(
+    KernelBudget(
+        backend="tpu-dense",
+        max_random_gathers=0,
+        max_scatters=0,
+        require_primitives=("dot_general",),
+        notes="matmul-only power step under lax.scan",
+    )
+)

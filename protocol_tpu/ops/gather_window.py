@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from ..analysis.budget import GatherBudget, KernelBudget, declare
 from .sparse import _ds_cumsum_axis1, rowsum_sorted, run_power_iteration
 
 try:
@@ -588,6 +589,7 @@ def power_step_windowed(
 @partial(
     jax.jit,
     static_argnames=("n_rows", "table_entries", "tol", "max_iter", "interpret"),
+    donate_argnames=("t0",),
 )
 def converge_windowed(
     wid: jax.Array,
@@ -610,7 +612,8 @@ def converge_windowed(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Fused-pipeline analog of ``converge_csr`` — same shared
     ``run_power_iteration`` driver, so early-exit semantics can't drift
-    between formulations."""
+    between formulations.  ``t0`` is donated (pass a fresh buffer);
+    the plan arrays are not — they are reused across epochs."""
     return run_power_iteration(
         lambda t: power_step_windowed(
             wid,
@@ -632,3 +635,35 @@ def converge_windowed(
         tol=tol,
         max_iter=max_iter,
     )
+
+
+# ---------------------------------------------------------------------------
+# Pinned kernel invariants (PERF.md §9) — checked per step by
+# `python -m protocol_tpu.analysis` against the traced jaxpr.
+# ---------------------------------------------------------------------------
+
+#: The tentpole contract of the fused fixed-slot pipeline (PERF.md §8):
+#: exactly two n_segments-sized gathers per step — the streaming
+#: sorted+unique (S, 2) boundary read and ONE random dst permutation —
+#: plus the four (n+1)-sized rowsum pointer reads; no scatter; the
+#: windowed Pallas kernel must actually be present (gathers inside its
+#: interpret body are excluded from the counts: on the real chip they
+#: are Mosaic codegen, not XLA gathers).
+declare(
+    KernelBudget(
+        backend="tpu-windowed",
+        max_random_gathers=5,
+        max_scatters=0,
+        require_primitives=("pallas_call",),
+        gather_budgets=(
+            GatherBudget(
+                dim="n_segments", max_total=2, max_random=1, boundary_sorted=True
+            ),
+        ),
+        donated_args=("t0",),
+        notes=(
+            "fused pipeline: 1 random n_segments pass (dst perm), "
+            "streaming 2-wide boundary read, 4 rowsum pointer reads"
+        ),
+    )
+)

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..analysis.budget import GatherBudget, KernelBudget, declare
+
 
 def _compensated_cumsum(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Inclusive prefix sum in double-single (hi, lo) arithmetic via
@@ -102,7 +104,10 @@ def rowsum_sorted(contrib: jax.Array, row_ptr: jax.Array) -> jax.Array:
     e = contrib.shape[0]
     b = _ROWSUM_BLOCK
     n_blocks = -(-e // b)
-    padded = jnp.zeros(n_blocks * b, contrib.dtype).at[:e].set(contrib)
+    # jnp.pad, not zeros().at[:e].set(): the update-slice form lowers
+    # to a real XLA scatter, which would break the scatter-free budget
+    # the analyzer pins on the CSR/windowed steps (analysis/budget.py).
+    padded = jnp.pad(contrib, (0, n_blocks * b - e))
     wh, wl = _ds_cumsum_axis1(padded.reshape(n_blocks, b))
     hi_in, lo_in = _compensated_cumsum(wh[:, -1] + wl[:, -1])
     # Exclusive block prefixes.
@@ -161,7 +166,7 @@ def run_power_iteration(step_fn, t0: jax.Array, *, tol: float, max_iter: int):
     return t, it, jnp.sum(jnp.abs(t - prev))
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter"))
+@partial(jax.jit, static_argnames=("tol", "max_iter"), donate_argnames=("t0",))
 def converge_csr(
     src: jax.Array,
     row_ptr: jax.Array,
@@ -174,7 +179,9 @@ def converge_csr(
     tol: float = 1e-6,
     max_iter: int = 50,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """CSR/cumsum analog of ``converge_sparse``."""
+    """CSR/cumsum analog of ``converge_sparse``.  ``t0`` is donated:
+    the iteration consumes the initial vector in place (4 MB saved at
+    the 1M-peer shape), so callers must pass a fresh buffer."""
     return run_power_iteration(
         lambda t: power_step_csr(src, row_ptr, w, t, p, dangling, alpha),
         t0,
@@ -207,7 +214,11 @@ def power_step_coo(
     return t_new / jnp.sum(t_new)
 
 
-@partial(jax.jit, static_argnames=("n", "tol", "max_iter", "sorted_by_dst"))
+@partial(
+    jax.jit,
+    static_argnames=("n", "tol", "max_iter", "sorted_by_dst"),
+    donate_argnames=("t0",),
+)
 def converge_sparse(
     src: jax.Array,
     dst: jax.Array,
@@ -225,7 +236,8 @@ def converge_sparse(
     """Iterate to an L1 fixed point; returns ``(t, iterations,
     residual)``.  ``tol <= 0`` runs exactly ``max_iter`` steps (the
     benchmarking mode — fixed work, no early exit).  ``alpha`` is a
-    traced operand so damping sweeps reuse one compiled kernel."""
+    traced operand so damping sweeps reuse one compiled kernel.
+    ``t0`` is donated — pass a fresh buffer."""
     return run_power_iteration(
         lambda t: power_step_coo(
             src, dst, w, t, p, dangling, alpha, n=n, sorted_by_dst=sorted_by_dst
@@ -234,3 +246,37 @@ def converge_sparse(
         tol=tol,
         max_iter=max_iter,
     )
+
+
+# ---------------------------------------------------------------------------
+# Pinned kernel invariants (PERF.md §9) — checked per step by
+# `python -m protocol_tpu.analysis` against the traced jaxpr.
+# ---------------------------------------------------------------------------
+
+#: COO segment-sum step: one random ``t[src]`` gather; the scatter-add
+#: is the formulation (segment_sum) and is capped at exactly one.
+declare(
+    KernelBudget(
+        backend="tpu-sparse",
+        max_random_gathers=1,
+        max_scatters=1,
+        gather_budgets=(GatherBudget(dim="edges", max_total=1, max_random=1),),
+        donated_args=("t0",),
+        notes="segment_sum SpMV: 1 random E-gather + 1 sorted scatter-add",
+    )
+)
+
+#: Gather-only CSR/cumsum step: one random ``t[src]`` gather plus the
+#: four (n+1)-sized block-prefix lookups of ``rowsum_sorted`` — and no
+#: scatter anywhere (the formulation's reason to exist; PERF.md §1
+#: measured segment_sum 2.4× slower end-to-end at the bench shape).
+declare(
+    KernelBudget(
+        backend="tpu-csr",
+        max_random_gathers=5,
+        max_scatters=0,
+        gather_budgets=(GatherBudget(dim="edges", max_total=1, max_random=1),),
+        donated_args=("t0",),
+        notes="scatter-free CSR: 1 random E-gather + 4 rowsum pointer reads",
+    )
+)

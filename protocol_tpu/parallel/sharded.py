@@ -57,6 +57,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..analysis.budget import GatherBudget, KernelBudget, declare
 from ..ops.gather_window import (
     BLOCK_ROWS,
     PLAN_VERSION,
@@ -411,7 +412,16 @@ def converge_sharded(
 
     Returns ``(t, iterations, final residual)``.  ``tol <= 0`` runs
     exactly ``max_iter`` fixed steps (benchmark mode).
+
+    ``alpha`` is staged explicitly with the mesh-replicated sharding:
+    a bare ``jnp.float32`` scalar (numpy's scalar type) would pay an
+    implicit host→device transfer every call, and a single-device
+    array an implicit device→device re-replication — both rejected by
+    the transfer guard the equivalence tests run under.
     """
+    alpha_dev = jax.device_put(
+        np.float32(alpha), NamedSharding(problem.mesh, P())
+    )
     if isinstance(problem, ShardedWindowPlan):
         run = _get_windowed_runner(
             problem.mesh,
@@ -431,7 +441,7 @@ def converge_sharded(
             problem.t0(),
             problem.p,
             problem.dangling,
-            jnp.float32(alpha),
+            alpha_dev,
             max_iter=max_iter,
             tol=tol,
         )
@@ -444,8 +454,47 @@ def converge_sharded(
         problem.t0(),
         problem.p,
         problem.dangling,
-        jnp.float32(alpha),
+        alpha_dev,
         max_iter=max_iter,
         tol=tol,
     )
     return t, int(it), float(resid)
+
+
+# ---------------------------------------------------------------------------
+# Pinned kernel invariants (PERF.md §9) — checked per step by
+# `python -m protocol_tpu.analysis` under the 8-device CPU mesh.
+# ---------------------------------------------------------------------------
+
+#: Per-shard CSR step under shard_map: the single-device CSR budget per
+#: shard, plus EXACTLY ONE psum completing boundary destinations — and
+#: that psum must sit under shard_map (outside, there is no mesh axis).
+declare(
+    KernelBudget(
+        backend="tpu-sharded:tpu-csr",
+        max_random_gathers=5,
+        max_scatters=0,
+        psum_count=1,
+        gather_budgets=(GatherBudget(dim="edges", max_total=1, max_random=1),),
+        notes="per-shard rowsum_sorted + one boundary-completing psum",
+    )
+)
+
+#: Per-shard fused windowed step under shard_map: the single-device
+#: windowed budget per shard (streaming boundary read, one random
+#: n_segments pass, Pallas kernel present) plus the same single psum.
+declare(
+    KernelBudget(
+        backend="tpu-sharded:tpu-windowed",
+        max_random_gathers=5,
+        max_scatters=0,
+        psum_count=1,
+        require_primitives=("pallas_call",),
+        gather_budgets=(
+            GatherBudget(
+                dim="n_segments", max_total=2, max_random=1, boundary_sorted=True
+            ),
+        ),
+        notes="sharded fused pipeline: per-shard windowed_ct + one psum",
+    )
+)

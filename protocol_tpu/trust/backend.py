@@ -186,7 +186,7 @@ class SparseJaxBackend(TrustBackend):
             jnp.asarray(p),
             jnp.asarray(dangling.astype(np.float32)),
             n=g.n,
-            alpha=jnp.float32(alpha),
+            alpha=jax.device_put(np.float32(alpha)),
             tol=tol,
             max_iter=max_iter,
         )
@@ -216,7 +216,7 @@ class CsrJaxBackend(TrustBackend):
             jnp.asarray(p),
             jnp.asarray(p),
             jnp.asarray(dangling.astype(np.float32)),
-            alpha=jnp.float32(alpha),
+            alpha=jax.device_put(np.float32(alpha)),
             tol=tol,
             max_iter=max_iter,
         )
@@ -278,7 +278,7 @@ class WindowedJaxBackend(TrustBackend):
             jnp.asarray(dangling.astype(np.float32)),
             n_rows=plan.n_rows,
             table_entries=plan.table_entries,
-            alpha=jnp.float32(alpha),
+            alpha=jax.device_put(np.float32(alpha)),
             tol=tol,
             max_iter=max_iter,
             interpret=interpret,
@@ -351,6 +351,23 @@ _BACKENDS = {
     "tpu-windowed": WindowedJaxBackend,
     "tpu-sharded": ShardedJaxBackend,
 }
+
+
+def registered_backends() -> list[str]:
+    """Every constructible backend name, composites expanded — the
+    enumeration the static analyzer hard-gates (each name must carry a
+    ``KERNEL_INVARIANTS`` declaration next to its kernel, or
+    ``python -m protocol_tpu.analysis`` fails the lint wall).  Plain
+    ``tpu-sharded`` is the ``tpu-sharded:tpu-csr`` composite."""
+    from ..parallel.sharded import SHARDED_KERNELS
+
+    names: list[str] = []
+    for base in _BACKENDS:
+        if base == "tpu-sharded":
+            names.extend(f"{base}:{kernel}" for kernel in sorted(SHARDED_KERNELS))
+        else:
+            names.append(base)
+    return names
 
 
 def get_backend(name: str, **kwargs) -> TrustBackend:

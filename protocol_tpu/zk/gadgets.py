@@ -462,7 +462,10 @@ class EdwardsChip:
             e = BJJ_D * tc % P * td % P
             f = (b - e) % P
             g = (b + e) % P
-            x3 = ta * f % P * ((v[self.rx] + v[self.ry]) * (v[self.ex] + v[self.ey]) - tc - td) % P
+            x3 = (
+                ta * f % P * ((v[self.rx] + v[self.ry]) * (v[self.ex] + v[self.ey]) - tc - td)
+                % P
+            )
             y3 = ta * g % P * ((td - BJJ_A * tc) % P) % P
             z3 = f * g % P
             return x3, y3, z3

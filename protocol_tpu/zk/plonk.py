@@ -1237,7 +1237,9 @@ def prove(
             tag = vk.perm_tags[j]
             sig = pk.sigma_values[j]
             for i in range(n - 1):
-                nums[i] = nums[i] * ((vals[i] + beta * tag % R * pk.row_tags[i] + gamma) % R) % R
+                nums[i] = (
+                    nums[i] * ((vals[i] + beta * tag % R * pk.row_tags[i] + gamma) % R) % R
+                )
                 dens[i] = dens[i] * ((vals[i] + beta * sig[i] + gamma) % R) % R
         den_inv = _batch_inv(dens[: n - 1])
         z = [0] * n

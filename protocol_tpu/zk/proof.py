@@ -337,7 +337,9 @@ class PoseidonCommitmentProver(Prover):
 
     name = "poseidon-commitment"
     wire_tag = "commitment"
-    DOMAIN = int.from_bytes(b"protocol_tpu.commit.v1".ljust(32, b"\0"), "little") % field.MODULUS
+    DOMAIN = (
+        int.from_bytes(b"protocol_tpu.commit.v1".ljust(32, b"\0"), "little") % field.MODULUS
+    )
 
     def _digest(self, pub_ins: list[int], witness: dict) -> int:
         acc = self.DOMAIN

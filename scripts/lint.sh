@@ -2,25 +2,34 @@
 # Lint wall (the reference's fmt-check.sh + clippy.sh analog,
 # .github/workflows/test.yml:32-37).  Runs the full ruff+mypy wall when
 # the tools exist; always runs the bytecode-compile floor so even
-# tool-less images (like the build image) get a syntax/structure gate.
+# tool-less images (like the build image) get a syntax/structure gate —
+# and always runs graftlint (`python -m protocol_tpu.analysis`), the
+# jaxpr/AST invariant analyzer that hard-gates every trust backend's
+# access-pattern contract (PERF.md §9).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.py
 
+# graftlint: pass 1 traces every registered backend's step to a jaxpr
+# and checks its declared KERNEL_INVARIANTS budget; pass 2 is the AST
+# ruleset over protocol_tpu/.  Any error-severity finding fails here.
+# Emits ANALYSIS.json (uploaded as a CI artifact).
+python -m protocol_tpu.analysis --output ANALYSIS.json
+
 # Trees held to the hard format/type gates: the convergence-kernel,
-# backend, mesh-parallel, and node code the fused-pipeline work
-# (PERF.md §7-8) touches.  The rest of the tree stays informational
-# until it is brought up to the wall.
-HARD_TREES="protocol_tpu/ops protocol_tpu/trust protocol_tpu/parallel protocol_tpu/node"
+# backend, mesh-parallel, node, analyzer, crypto, and zk code.  crypto/
+# and zk/ were promoted from informational with the analyzer work —
+# the whole proving path now sits behind the same wall as the kernels.
+HARD_TREES="protocol_tpu/ops protocol_tpu/trust protocol_tpu/parallel protocol_tpu/node protocol_tpu/analysis protocol_tpu/crypto protocol_tpu/zk"
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check .
-    # Hard gate on the kernel/backend trees; informational elsewhere.
+    # Hard gate on the kernel/backend/proving trees; informational elsewhere.
     ruff format --check $HARD_TREES
     ruff format --check . || echo "lint: format drift outside $HARD_TREES (informational)" >&2
 else
-    echo "lint: ruff not installed; ran compileall floor only" >&2
+    echo "lint: ruff not installed; ran compileall + analysis floor only" >&2
 fi
 if command -v mypy >/dev/null 2>&1; then
     mypy $HARD_TREES

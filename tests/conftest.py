@@ -25,5 +25,28 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
+
+#: Modules run under ``jax.transfer_guard("disallow")``: the
+#: backend-equivalence suites, where an implicit host transfer means a
+#: per-call (or worse, per-iteration) sync hiding in a hot path — the
+#: runtime counterpart of the analyzer's bare-sync/host-op AST rules.
+#: Explicit staging (``jnp.asarray``/``device_put``/``device_get``)
+#: stays legal; tests that legitimately rely on implicit transfers opt
+#: out with ``@pytest.mark.allow_transfer``.
+_TRANSFER_GUARDED = {"test_trust_backends", "test_windowed_pipeline"}
+
+
+@pytest.fixture(autouse=True)
+def _no_implicit_transfers(request):
+    module = getattr(request.node, "module", None)
+    name = getattr(module, "__name__", "").rpartition(".")[2]
+    if name not in _TRANSFER_GUARDED or request.node.get_closest_marker(
+        "allow_transfer"
+    ):
+        yield
+        return
+    with jax.transfer_guard("disallow"):
+        yield

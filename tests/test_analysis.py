@@ -1,0 +1,271 @@
+"""graftlint (protocol_tpu.analysis) — the ISSUE 3 acceptance suite.
+
+Covers: every seeded violation fixture fires exactly its rule with the
+right ``file:line`` (resolved against the ``# VIOLATION:`` markers in
+``analysis/fixtures.py``), the CLI exits non-zero on fixtures and zero
+on the real tree, every registered jax backend carries >= 3 checked
+invariants (with the one-random-gather budget pinned on the windowed
+rungs), an undeclared backend is itself a gate failure, and the AST
+ruleset fires/stays-quiet on minimal positive/negative snippets.
+"""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+import protocol_tpu.analysis.fixtures as fixtures_mod
+from protocol_tpu.analysis import KERNEL_INVARIANTS, NON_JAX_BACKENDS
+from protocol_tpu.analysis.__main__ import main as analysis_main
+from protocol_tpu.analysis.ast_rules import scan_file
+from protocol_tpu.analysis.fixtures import FIXTURES, run_fixture
+from protocol_tpu.analysis.invariants import run_jaxpr_pass
+from protocol_tpu.trust.backend import registered_backends
+
+FIXTURES_PATH = Path(fixtures_mod.__file__)
+
+#: The acceptance floor applies to every backend on the ladder.
+ACCEPTANCE_BACKENDS = (
+    "tpu-dense",
+    "tpu-sparse",
+    "tpu-csr",
+    "tpu-windowed",
+    "tpu-sharded:tpu-csr",
+    "tpu-sharded:tpu-windowed",
+)
+
+
+def _marker_lines() -> dict[str, int]:
+    """``# VIOLATION: <name>`` marker -> 1-based line in fixtures.py."""
+    out: dict[str, int] = {}
+    for i, line in enumerate(FIXTURES_PATH.read_text().splitlines(), start=1):
+        m = re.search(r"# VIOLATION: ([\w-]+)", line)
+        if m:
+            out[m.group(1)] = i
+    return out
+
+
+class TestViolationFixtures:
+    """Each seeded violation fires its rule — and only its rule — with
+    the marked ``file:line``."""
+
+    @pytest.mark.parametrize("name", sorted(FIXTURES))
+    def test_fires_expected_rule_at_marked_line(self, name):
+        fixture = FIXTURES[name]
+        findings = run_fixture(name)
+        errors = [f for f in findings if f.severity == "error"]
+        assert errors, f"fixture {name} produced no error finding"
+        assert {f.rule for f in errors} == {fixture.rule}
+        finding = errors[0]
+        if fixture.marker is None:
+            return
+        assert finding.file is not None and finding.file.endswith("fixtures.py")
+        assert finding.line == _marker_lines()[fixture.marker], (
+            f"{name}: finding anchored at {finding.file}:{finding.line}, "
+            f"marker at line {_marker_lines()[fixture.marker]}"
+        )
+
+    def test_cli_exits_nonzero_on_fixture(self, tmp_path):
+        out = tmp_path / "fixture.json"
+        rc = analysis_main(["--fixture", "extra-gather", "--output", str(out)])
+        assert rc == 1
+        report = json.loads(out.read_text())
+        assert report["summary"]["error"] == 1
+        assert report["findings"][0]["rule"] == "gather-budget"
+
+    def test_cli_rejects_unknown_fixture(self, tmp_path):
+        rc = analysis_main(
+            ["--fixture", "bogus", "--output", str(tmp_path / "x.json")]
+        )
+        assert rc == 2
+
+
+@pytest.fixture(scope="module")
+def real_report(tmp_path_factory):
+    """One full two-pass run over the real tree (module-scoped: the
+    jaxpr pass traces all six backends)."""
+    out = tmp_path_factory.mktemp("analysis") / "ANALYSIS.json"
+    rc = analysis_main(["--output", str(out)])
+    return rc, json.loads(out.read_text())
+
+
+class TestRealTree:
+    def test_gate_passes_on_real_tree(self, real_report):
+        rc, report = real_report
+        assert report["summary"]["error"] == 0, report["findings"]
+        assert rc == 0
+
+    def test_every_registered_backend_covered(self, real_report):
+        _, report = real_report
+        for name in registered_backends():
+            assert name in report["backends"], f"{name} missing from report"
+            status = report["backends"][name]["status"]
+            expected = "skipped" if name in NON_JAX_BACKENDS else "checked"
+            assert status == expected, (name, status)
+
+    def test_acceptance_backends_have_three_invariants(self, real_report):
+        _, report = real_report
+        for name in ACCEPTANCE_BACKENDS:
+            checked = report["backends"][name]["invariants_checked"]
+            assert checked >= 3, f"{name}: only {checked} invariants checked"
+
+    def test_windowed_one_random_gather_budget_enforced(self, real_report):
+        """ISSUE 3 acceptance: the one-random-gather budget for the
+        windowed rungs is the analyzer's, not only the unit test's."""
+        _, report = real_report
+        for name in ("tpu-windowed", "tpu-sharded:tpu-windowed"):
+            (gb,) = report["backends"][name]["budget"]["gather_budgets"]
+            assert gb["dim"] == "n_segments"
+            assert gb["max_random"] == 1
+            assert gb["boundary_sorted"] is True
+
+    def test_ast_pass_scanned_the_tree(self, real_report):
+        _, report = real_report
+        assert report["summary"]["files_scanned"] > 50
+
+
+class TestRegistryGate:
+    def test_undeclared_backend_is_error(self):
+        """A backend name with no KERNEL_INVARIANTS entry fails the
+        gate — adding a rung without pinning it is itself a finding."""
+        findings, meta = run_jaxpr_pass(backends=["tpu-quantum"])
+        assert meta["tpu-quantum"]["status"] == "undeclared"
+        assert any(
+            f.rule == "undeclared-backend" and f.severity == "error"
+            for f in findings
+        )
+
+    def test_table_matches_registry(self):
+        declared = set(KERNEL_INVARIANTS)
+        registered = {
+            n for n in registered_backends() if n not in NON_JAX_BACKENDS
+        }
+        assert declared == registered
+
+
+class TestBudgetRules:
+    """Direct rule coverage the seeded fixtures don't reach."""
+
+    def test_sized_random_budget_fires_on_second_random_pass(self):
+        """The windowed acceptance invariant: a second random
+        n_segments-sized pass trips `random-gather-budget` even when
+        the global gather budget would tolerate it."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from protocol_tpu.analysis import GatherBudget, KernelBudget
+        from protocol_tpu.analysis.invariants import TraceCase, check_case
+
+        x = jnp.asarray(np.arange(32.0, dtype=np.float32))
+        idx = jnp.asarray(np.array([3, 1, 2, 5, 4, 0], np.int32))
+
+        def step(x, idx):
+            return x[idx] + x[idx + 1]  # two random (6,)-sized passes
+
+        jaxpr = jax.make_jaxpr(step)(x, idx)
+        budget = KernelBudget(
+            backend="unit",
+            max_random_gathers=8,
+            gather_budgets=(
+                GatherBudget(dim="n_segments", max_total=8, max_random=1),
+            ),
+        )
+        findings = check_case(
+            budget, TraceCase("unit", jaxpr, dims={"n_segments": 6})
+        )
+        assert {f.rule for f in findings} == {"random-gather-budget"}
+
+    def test_psum_count_mismatch_fires(self):
+        from protocol_tpu.analysis import KernelBudget
+        from protocol_tpu.analysis.invariants import (
+            TRACE_BUILDERS,
+            _synthetic_graph,
+            check_case,
+        )
+
+        case = TRACE_BUILDERS["tpu-sharded:tpu-csr"](_synthetic_graph())
+        budget = KernelBudget(
+            backend="unit", max_random_gathers=99, max_scatters=99, psum_count=0
+        )
+        findings = check_case(budget, case)
+        assert "psum-count" in {f.rule for f in findings}
+
+
+def _scan(tmp_path: Path, rel: str, code: str):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(code)
+    return scan_file(path, tmp_path)
+
+
+class TestAstRules:
+    def test_np_asarray_in_jit(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/ops/x.py",
+            "import jax\nimport numpy as np\n"
+            "@jax.jit\ndef f(x):\n    return np.asarray(x)\n",
+        )
+        assert [f.rule for f in findings] == ["host-op-in-jit"]
+        assert findings[0].line == 5
+
+    def test_item_and_float_in_jit(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/ops/x.py",
+            "from functools import partial\nimport jax\n"
+            "@partial(jax.jit, static_argnames=('n',))\n"
+            "def f(x, n):\n"
+            "    a = x.item()\n"
+            "    b = float(x)\n"
+            "    c = float(3.5)\n"
+            "    return a + b + c\n",
+        )
+        assert [f.rule for f in findings] == ["host-op-in-jit"] * 2
+        assert [f.line for f in findings] == [5, 6]
+
+    def test_host_ops_outside_jit_are_fine(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/ops/x.py",
+            "import numpy as np\ndef f(x):\n    return float(np.asarray(x))\n",
+        )
+        assert findings == []
+
+    def test_import_time_jnp_in_hot_tree(self, tmp_path):
+        code = "import jax.numpy as jnp\nTABLE = jnp.zeros(4)\nDT = jnp.float32\n"
+        hot = _scan(tmp_path, "protocol_tpu/ops/y.py", code)
+        assert [f.rule for f in hot] == ["import-time-jnp"]
+        assert hot[0].line == 2
+        cold = _scan(tmp_path, "protocol_tpu/zk/y.py", code)
+        assert cold == []
+
+    def test_jnp_inside_function_is_fine(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/ops/y.py",
+            "import jax.numpy as jnp\ndef f():\n    return jnp.zeros(4)\n",
+        )
+        assert findings == []
+
+    def test_bare_sync(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/z.py",
+            "import jax\ndef f(x):\n"
+            "    jax.device_get(x)\n"
+            "    x.block_until_ready()\n"
+            "    y = jax.device_get(x)\n"
+            "    return y\n",
+        )
+        assert [f.rule for f in findings] == ["bare-sync"] * 2
+        assert [f.line for f in findings] == [3, 4]
+
+    def test_real_tree_is_clean(self, real_report):
+        _, report = real_report
+        ast_errors = [
+            f for f in report["findings"] if f["pass"] == "ast" and f["severity"] == "error"
+        ]
+        assert ast_errors == []

@@ -48,8 +48,10 @@ class TestDenseKernel:
         )
 
     def test_jit_static_iters(self):
-        c = jnp.eye(4)
-        s = jnp.ones(4)
+        # Explicit staging: eager jnp constructors (eye/ones) build from
+        # host scalars, which the module's transfer guard rejects.
+        c = jnp.asarray(np.eye(4, dtype=np.float32))
+        s = jnp.asarray(np.ones(4, np.float32))
         assert converge_dense(c, s, 3).shape == (4,)
 
 
@@ -210,6 +212,23 @@ class TestBenchLadder:
         curve = entries[-1]["sybil_mass_curve"]
         masses = [p["sybil_mass"] for p in curve]
         assert masses == sorted(masses, reverse=True)  # damping squeezes the clique
+
+
+class TestTransferGuard:
+    """This module runs under ``jax.transfer_guard("disallow")``
+    (conftest): implicit transfers in any backend path fail loudly
+    here, so a hidden per-iteration host sync can't land silently."""
+
+    def test_implicit_transfers_rejected_here(self):
+        step = jax.jit(lambda a: a * 2)
+        with pytest.raises(Exception, match="Disallowed host-to-device"):
+            step(np.arange(3.0, dtype=np.float32))
+
+    @pytest.mark.allow_transfer
+    def test_marker_opts_out(self):
+        step = jax.jit(lambda a: a * 2)
+        out = np.asarray(step(np.arange(3.0, dtype=np.float32)))
+        np.testing.assert_allclose(out, [0.0, 2.0, 4.0])
 
 
 class TestWindowedGather:

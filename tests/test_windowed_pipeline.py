@@ -19,6 +19,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from protocol_tpu.analysis.jaxpr_walk import collect_gathers
 from protocol_tpu.models.graphs import erdos_renyi, scale_free
 from protocol_tpu.node.checkpoint import CheckpointStore
 from protocol_tpu.node.epoch import Epoch
@@ -190,23 +191,6 @@ class TestBucketByWindowProperties:
         assert int(b["dst_ptr"][-1]) == b["n_segments"]
 
 
-def _collect_gathers(jaxpr, out):
-    """Recursively collect gather eqns, descending into sub-jaxprs
-    (pjit, while, pallas interpret bodies)."""
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "gather":
-            out.append(eqn)
-        for v in eqn.params.values():
-            for sub in jax.tree_util.tree_leaves(
-                v, is_leaf=lambda x: hasattr(x, "eqns") or hasattr(x, "jaxpr")
-            ):
-                if hasattr(sub, "jaxpr"):
-                    _collect_gathers(sub.jaxpr, out)
-                elif hasattr(sub, "eqns"):
-                    _collect_gathers(sub, out)
-    return out
-
-
 class TestSinglePassBoundary:
     """ISSUE 2 acceptance: per-iteration boundary random volume in
     ``power_step_windowed`` is ONE n_segments-sized random gather."""
@@ -220,7 +204,7 @@ class TestSinglePassBoundary:
             jnp.asarray(p),
             jnp.asarray(p),
             jnp.asarray(dangling.astype(np.float32)),
-            jnp.float32(0.1),
+            jax.device_put(np.float32(0.1)),
         )
         jaxpr = jax.make_jaxpr(
             lambda *a: power_step_windowed(
@@ -230,7 +214,9 @@ class TestSinglePassBoundary:
                 interpret=True,
             )
         )(*args)
-        gathers = _collect_gathers(jaxpr.jaxpr, [])
+        # The shared recursive walker (protocol_tpu.analysis.jaxpr_walk)
+        # — the analyzer gate counts gathers with exactly this traversal.
+        gathers = collect_gathers(jaxpr.jaxpr)
         s = plan.n_segments
         assert s != plan.n + 1  # keep the rowsum gathers distinguishable
         seg_sized = [e for e in gathers if e.outvars[0].aval.shape[:1] == (s,)]
