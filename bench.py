@@ -48,6 +48,7 @@ def headline_entry(
     import numpy as np
 
     from protocol_tpu.models.graphs import scale_free
+    from protocol_tpu.obs import TRACER
     from protocol_tpu.ops.gather_window import build_window_plan, converge_windowed
     from protocol_tpu.ops.sparse import converge_csr
     from protocol_tpu.trust.graph import TrustGraph
@@ -60,6 +61,11 @@ def headline_entry(
     g = TrustGraph(g.n, g.src, g.dst, w, graph.pre_trusted).sorted_by_dst()
     p = graph.pre_trust_vector()
     extra: dict = {}
+    # Span-derived phase timings (ISSUE 4): the bench emits the SAME
+    # obs spans the node's epoch tick does (plan, converge), so a
+    # BENCH_*.json line and a production /trace/<epoch> use identical
+    # phase names.
+    phases: dict = {}
 
     if backend == "tpu-csr":
         device_args = (
@@ -98,9 +104,11 @@ def headline_entry(
         # One-time static plan: excluded from the per-iteration metric
         # (it amortizes across epochs and reboots via the checkpoint
         # store) but reported so regressions in host bucketing show up.
-        plan, plan_dt = _timed(
-            lambda: build_window_plan(g.src, g.dst, g.weight, n=g.n)
-        )
+        with TRACER.span("plan", backend=backend) as plan_span:
+            plan, plan_dt = _timed(
+                lambda: build_window_plan(g.src, g.dst, g.weight, n=g.n)
+            )
+        phases["plan"] = round(plan_span.duration_s or 0.0, 4)
         interpret = jax.default_backend() != "tpu"
         device_args = tuple(jax.device_put(a) for a in plan.device_args()) + (
             jax.device_put(jnp.asarray(p)),
@@ -140,7 +148,9 @@ def headline_entry(
         from protocol_tpu.parallel.sharded import ShardedWindowPlan, converge_sharded
 
         mesh = default_mesh()
-        swp, plan_dt = _timed(lambda: ShardedWindowPlan.build(graph, mesh))
+        with TRACER.span("plan", backend=backend) as plan_span:
+            swp, plan_dt = _timed(lambda: ShardedWindowPlan.build(graph, mesh))
+        phases["plan"] = round(plan_span.duration_s or 0.0, 4)
         extra = {
             "plan_seconds": round(plan_dt, 4),
             "bridge_segments": swp.plan.n_segments,
@@ -161,8 +171,10 @@ def headline_entry(
 
     run()  # compile + warm up
     t0 = time.perf_counter()
-    scores = run()
+    with TRACER.span("converge", backend=backend):
+        scores = run()
     elapsed = time.perf_counter() - t0
+    phases["converge"] = round(elapsed, 4)
     assert abs(scores.sum() - 1.0) < 1e-3
 
     return {
@@ -170,6 +182,7 @@ def headline_entry(
         "value": round(elapsed, 4),
         "unit": "seconds",
         "vs_baseline": round(target_seconds / elapsed, 3),
+        "phases": phases,
         **extra,
     }
 

@@ -1,6 +1,6 @@
 """Pass 2 — the AST repo-lint: hazards mypy/ruff don't model.
 
-Three rules over ``protocol_tpu/``, each an implicit-host-sync or
+Rules over ``protocol_tpu/``, each an implicit-host-sync or
 import-cost hazard the jaxpr pass can't see (it only traces registered
 backends):
 
@@ -11,12 +11,34 @@ backends):
   shape math belongs outside the jit boundary.
 - ``import-time-jnp`` (error, hot trees only): ``jnp.*`` array
   construction at module scope in ``ops/``, ``trust/``, ``parallel/``,
-  ``node/`` — it initializes the device backend (and possibly a TPU
-  runtime grab) as an import side effect.
+  ``node/``, ``obs/`` — it initializes the device backend (and
+  possibly a TPU runtime grab) as an import side effect.
 - ``bare-sync`` (error): a bare ``jax.device_get(...)`` or
   ``x.block_until_ready()`` expression statement whose result is
   discarded — a synchronization point that belongs in ``bench/`` or
   ``tests/``, not in library code.
+
+Pass 3 — the observability-boundary rules (the obs subsystem's
+"spans only at host boundaries" doctrine, enforced structurally):
+
+- ``host-clock-in-jit`` (error): ``time.time()``/``perf_counter()``/
+  ``monotonic()`` (or an obs span) inside a traced function — a
+  ``@jit``- or ``shard_map``-decorated function, or any function
+  nested in one.  A clock read there executes once at trace time and
+  then lies forever, or (under a callback) syncs every iteration;
+  per-iteration timing data belongs in the device-side loop carry
+  (``ops.sparse.run_power_iteration``'s residual history), host
+  timing at the jit boundary.
+- ``logging-in-jit`` (error): ``logging``/``logger.*``/``log.*`` or
+  ``print`` calls inside a traced function — same trace-time lie, and
+  a ``jax.debug.print``-shaped rewrite would smuggle a callback into
+  the hot loop.
+- ``clock-in-kernel-tree`` (error): any use of the ``time`` or
+  ``logging`` modules (or ``print``) anywhere in the device-kernel
+  trees ``ops/`` and ``parallel/`` — instrumentation wraps kernels
+  from the outside (``trust/backend.py``, ``node/``); the kernel
+  modules themselves stay clock- and logger-free so no refactor can
+  quietly move a host boundary inside one.
 """
 
 from __future__ import annotations
@@ -28,7 +50,12 @@ from .report import Finding
 
 #: Trees where import-time device work is a hard error (the modules the
 #: node imports on its boot path).
-HOT_TREES = ("ops", "trust", "parallel", "node")
+HOT_TREES = ("ops", "trust", "parallel", "node", "obs")
+
+#: Device-kernel trees: no clock, no logging, no print anywhere — the
+#: obs instrumentation layer wraps these modules from the outside
+#: (trust/backend.py, node/), never from within.
+KERNEL_TREES = ("ops", "parallel")
 
 #: jnp attributes that are plain dtypes/constants, not array factories.
 _JNP_DTYPE_NAMES = frozenset(
@@ -74,15 +101,31 @@ def _dotted(node: ast.expr) -> str | None:
     return None
 
 
-def _is_jit_decorator(dec: ast.expr) -> bool:
-    """True for ``@jit``, ``@jax.jit``, ``@partial(jax.jit, ...)``,
-    ``@functools.partial(jax.jit, ...)``, and ``@jax.jit(...)``."""
+#: Decorator names that make a function body traced code: its Python
+#: executes once at trace time, so host side effects inside lie.
+_JIT_NAMES = ("jit", "jax.jit")
+_SHARD_MAP_NAMES = (
+    "shard_map",
+    "_shard_map",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+)
+
+
+def _is_traced_decorator(dec: ast.expr, names: tuple[str, ...]) -> bool:
+    """True for ``@f``, ``@mod.f``, ``@partial(f, ...)``,
+    ``@functools.partial(f, ...)``, and ``@f(...)`` for any ``f`` in
+    ``names``."""
     if isinstance(dec, ast.Call):
         name = _dotted(dec.func)
         if name in ("partial", "functools.partial") and dec.args:
-            return _dotted(dec.args[0]) in ("jit", "jax.jit")
-        return name in ("jit", "jax.jit")
-    return _dotted(dec) in ("jit", "jax.jit")
+            return _dotted(dec.args[0]) in names
+        return name in names
+    return _dotted(dec) in names
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    return _is_traced_decorator(dec, _JIT_NAMES)
 
 
 def _is_literal(node: ast.expr) -> bool:
@@ -91,11 +134,65 @@ def _is_literal(node: ast.expr) -> bool:
     )
 
 
+#: Host clock reads (module-qualified and ``from time import ...`` bare
+#: forms) — pass-3 hazards inside traced functions and kernel trees.
+_CLOCK_CALLS = frozenset(
+    {
+        "time.time",
+        "time.time_ns",
+        "time.perf_counter",
+        "time.perf_counter_ns",
+        "time.monotonic",
+        "time.monotonic_ns",
+        "time.process_time",
+        "perf_counter",
+        "perf_counter_ns",
+        "monotonic",
+        "monotonic_ns",
+    }
+)
+_LOGGING_METHODS = frozenset(
+    {"debug", "info", "warning", "warn", "error", "exception", "critical", "log"}
+)
+
+
+def _is_clock_call(name: str | None) -> bool:
+    return name is not None and name in _CLOCK_CALLS
+
+
+def _is_logging_call(name: str | None) -> bool:
+    """``logging.*``, ``log.<level>``/``logger.<level>``/``self.log.*``
+    receivers, and bare ``print``."""
+    if name is None:
+        return False
+    if name == "print":
+        return True
+    root, _, rest = name.partition(".")
+    if root == "logging":
+        return True
+    leaf = name.rsplit(".", 1)[-1]
+    receiver = name.rsplit(".", 2)[-2] if "." in name else ""
+    return leaf in _LOGGING_METHODS and receiver in ("log", "logger")
+
+
+def _is_span_call(name: str | None) -> bool:
+    """obs span entry points (``TRACER.span``/``TRACER.epoch`` or any
+    ``*.span(...)``) — host boundaries by definition, so inside a
+    traced function they are always a bug."""
+    if name is None:
+        return False
+    return name.endswith(".span") or name in ("TRACER.epoch", "TRACER.span")
+
+
 class _Visitor(ast.NodeVisitor):
-    def __init__(self, rel_path: str, hot: bool) -> None:
+    def __init__(self, rel_path: str, hot: bool, kernel_tree: bool = False) -> None:
         self.rel_path = rel_path
         self.hot = hot
+        self.kernel_tree = kernel_tree
         self.jit_depth = 0
+        #: Depth inside jit- OR shard_map-decorated functions (pass 3):
+        #: shard_map bodies are traced exactly like jit bodies.
+        self.traced_depth = 0
         self.fn_depth = 0
         self.findings: list[Finding] = []
 
@@ -115,9 +212,14 @@ class _Visitor(ast.NodeVisitor):
 
     def _visit_function(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
         jitted = any(_is_jit_decorator(d) for d in node.decorator_list)
+        traced = jitted or any(
+            _is_traced_decorator(d, _SHARD_MAP_NAMES) for d in node.decorator_list
+        )
         self.fn_depth += 1
         self.jit_depth += 1 if jitted else 0
+        self.traced_depth += 1 if traced else 0
         self.generic_visit(node)
+        self.traced_depth -= 1 if traced else 0
         self.jit_depth -= 1 if jitted else 0
         self.fn_depth -= 1
 
@@ -159,6 +261,35 @@ class _Visitor(ast.NodeVisitor):
                     "concretizes a traced value",
                     node,
                 )
+        if self.traced_depth > 0:
+            # Pass 3: the obs boundary doctrine — no clocks, spans, or
+            # logging inside traced code (jit or shard_map bodies).
+            if _is_clock_call(name) or _is_span_call(name):
+                self._emit(
+                    "host-clock-in-jit",
+                    f"{name}() inside a traced function reads the host "
+                    "clock at trace time (spans/timing belong at the "
+                    "jit boundary; per-iteration data in the loop carry)",
+                    node,
+                )
+            elif _is_logging_call(name):
+                self._emit(
+                    "logging-in-jit",
+                    f"{name}() inside a traced function executes once "
+                    "at trace time, not per call — log at the host "
+                    "boundary instead",
+                    node,
+                )
+        elif self.kernel_tree and (
+            _is_clock_call(name) or _is_logging_call(name)
+        ):
+            self._emit(
+                "clock-in-kernel-tree",
+                f"{name}() in a device-kernel tree ({'/'.join(KERNEL_TREES)}): "
+                "instrumentation wraps kernels from trust/ and node/, "
+                "never from inside ops/ or parallel/",
+                node,
+            )
         if (
             self.fn_depth == 0
             and self.hot
@@ -174,6 +305,28 @@ class _Visitor(ast.NodeVisitor):
                     "effect",
                     node,
                 )
+        self.generic_visit(node)
+
+    def _check_kernel_import(self, node: ast.stmt, module: str | None) -> None:
+        if self.kernel_tree and module is not None and module.split(".")[0] in (
+            "time",
+            "logging",
+        ):
+            self._emit(
+                "clock-in-kernel-tree",
+                f"import of {module!r} in a device-kernel tree — clocks "
+                "and loggers stay outside ops/ and parallel/ (spans at "
+                "host boundaries only)",
+                node,
+            )
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self._check_kernel_import(node, alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        self._check_kernel_import(node, node.module)
         self.generic_visit(node)
 
     def visit_Expr(self, node: ast.Expr) -> None:
@@ -193,15 +346,20 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def _is_hot(rel_path: str) -> bool:
+def _in_tree(rel_path: str, trees: tuple[str, ...]) -> bool:
     parts = Path(rel_path).parts
-    return len(parts) >= 2 and parts[0] == "protocol_tpu" and parts[1] in HOT_TREES
+    return len(parts) >= 2 and parts[0] == "protocol_tpu" and parts[1] in trees
 
 
-def scan_file(path: Path, root: Path) -> list[Finding]:
-    rel = str(path.relative_to(root))
+def _is_hot(rel_path: str) -> bool:
+    return _in_tree(rel_path, HOT_TREES)
+
+
+def scan_source(source: str, rel_path: str) -> list[Finding]:
+    """Run the AST ruleset over in-memory source (seeded violation
+    fixtures use this; ``scan_file`` is the on-disk wrapper)."""
     try:
-        tree = ast.parse(path.read_text(), filename=rel)
+        tree = ast.parse(source, filename=rel_path)
     except SyntaxError as exc:
         return [
             Finding(
@@ -209,13 +367,22 @@ def scan_file(path: Path, root: Path) -> list[Finding]:
                 rule="syntax-error",
                 severity="error",
                 message=str(exc),
-                file=rel,
+                file=rel_path,
                 line=exc.lineno,
             )
         ]
-    visitor = _Visitor(rel, hot=_is_hot(rel))
+    visitor = _Visitor(
+        rel_path,
+        hot=_is_hot(rel_path),
+        kernel_tree=_in_tree(rel_path, KERNEL_TREES),
+    )
     visitor.visit(tree)
     return visitor.findings
+
+
+def scan_file(path: Path, root: Path) -> list[Finding]:
+    rel = str(path.relative_to(root))
+    return scan_source(path.read_text(), rel)
 
 
 def run_ast_pass(root: str | Path | None = None) -> tuple[list[Finding], int]:
@@ -231,4 +398,4 @@ def run_ast_pass(root: str | Path | None = None) -> tuple[list[Finding], int]:
     return findings, len(files)
 
 
-__all__ = ["HOT_TREES", "run_ast_pass", "scan_file"]
+__all__ = ["HOT_TREES", "KERNEL_TREES", "run_ast_pass", "scan_file", "scan_source"]

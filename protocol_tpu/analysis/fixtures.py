@@ -24,10 +24,16 @@ from .report import Finding
 class Fixture:
     name: str
     rule: str  # the finding rule this fixture must trigger
-    build: Callable[[], tuple[KernelBudget, TraceCase]]
+    #: jaxpr fixtures return ``(budget, case)`` for ``check_case``; ast
+    #: fixtures return ``(source, rel_path)`` for ``scan_source`` —
+    #: violating code lives in strings, never as real module code, so
+    #: the fixture file itself stays clean under the repo-wide pass.
+    build: Callable[[], tuple]
     #: Marker suffix of the ``# VIOLATION:`` comment anchoring the
     #: expected finding line; None when the finding has no source site.
     marker: str | None
+    #: Which analyzer pass evaluates this fixture.
+    kind: str = "jaxpr"
 
 
 def _extra_gather() -> tuple[KernelBudget, TraceCase]:
@@ -150,6 +156,59 @@ def _missing_donation() -> tuple[KernelBudget, TraceCase]:
     )
 
 
+#: Pass-3 seeded violations (observability-boundary rules).  The source
+#: lives in strings so the AST pass over the real tree never sees it;
+#: the fake paths place them in a hot/kernel tree so tree-scoped rules
+#: apply exactly as they would to real code.
+_TIME_IN_JIT_SRC = '''\
+import time
+
+import jax
+
+
+@jax.jit
+def step(t):
+    t0 = time.perf_counter()  # VIOLATION: time-in-jit
+    return t * 2.0, t0
+'''
+
+
+def _time_in_jit() -> tuple[str, str]:
+    return _TIME_IN_JIT_SRC, "protocol_tpu/trust/_fixture_time_in_jit.py"
+
+
+_LOGGING_IN_JIT_SRC = '''\
+import logging
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+@jax.jit
+def step(t):
+    log.info("converged to %s", t)  # VIOLATION: logging-in-jit
+    return t * 2.0
+'''
+
+
+def _logging_in_jit() -> tuple[str, str]:
+    return _LOGGING_IN_JIT_SRC, "protocol_tpu/trust/_fixture_logging_in_jit.py"
+
+
+_CLOCK_IN_KERNEL_SRC = '''\
+import time  # VIOLATION: clock-in-kernel-tree
+
+
+def rowsum_probe(x):
+    return time.monotonic(), x
+'''
+
+
+def _clock_in_kernel_tree() -> tuple[str, str]:
+    return _CLOCK_IN_KERNEL_SRC, "protocol_tpu/ops/_fixture_clock_in_kernel.py"
+
+
 FIXTURES: dict[str, Fixture] = {
     f.name: f
     for f in (
@@ -170,6 +229,18 @@ FIXTURES: dict[str, Fixture] = {
         Fixture(
             "missing-donation", "donation-not-materialized", _missing_donation, None
         ),
+        Fixture(
+            "time-in-jit", "host-clock-in-jit", _time_in_jit, "time-in-jit",
+            kind="ast",
+        ),
+        Fixture(
+            "logging-in-jit", "logging-in-jit", _logging_in_jit,
+            "logging-in-jit", kind="ast",
+        ),
+        Fixture(
+            "clock-in-kernel-tree", "clock-in-kernel-tree",
+            _clock_in_kernel_tree, "clock-in-kernel-tree", kind="ast",
+        ),
     )
 }
 
@@ -178,6 +249,11 @@ def run_fixture(name: str) -> list[Finding]:
     """Trace and check one seeded violation; raises KeyError on an
     unknown name (the CLI lists valid ones)."""
     fixture = FIXTURES[name]
+    if fixture.kind == "ast":
+        from .ast_rules import scan_source
+
+        source, rel_path = fixture.build()
+        return scan_source(source, rel_path)
     budget, case = fixture.build()
     return check_case(budget, case)
 

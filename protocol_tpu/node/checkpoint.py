@@ -28,6 +28,8 @@ from pathlib import Path
 
 import numpy as np
 
+from ..obs import TRACER
+from ..obs.metrics import CHECKPOINT_RESTORES, CHECKPOINT_SAVES
 from ..ops.gather_window import WindowPlan
 from ..trust.graph import TrustGraph
 from .epoch import Epoch
@@ -71,6 +73,7 @@ class CheckpointStore:
         proof_json: str | None = None,
         plan: WindowPlan | None = None,
     ) -> Path:
+        CHECKPOINT_SAVES.inc()
         path = self._path(epoch)
         payload = {
             "n": np.int64(graph.n),
@@ -123,29 +126,31 @@ class CheckpointStore:
         ]
 
     def load(self, epoch: Epoch) -> Snapshot:
-        with np.load(self._path(epoch)) as z:
-            graph = TrustGraph(
-                n=int(z["n"]),
-                src=z["src"],
-                dst=z["dst"],
-                weight=z["weight"],
-                pre_trusted=z["pre_trusted"] if "pre_trusted" in z else None,
-            )
-            scores = np.array(z["scores"]) if "scores" in z else None
-        proof_path = self.dir / f"epoch_{epoch.number}.proof.json"
-        proof_json = proof_path.read_text() if proof_path.exists() else None
-        plan_path = self.dir / f"epoch_{epoch.number}.plan.npz"
-        plan = None
-        if plan_path.exists():
-            with np.load(plan_path) as pz:
-                try:
-                    plan = WindowPlan.from_arrays(pz)
-                except (ValueError, KeyError):
-                    # Plan written by an older layout version (e.g. the
-                    # pre-v2 dst-sorted boundary pairs): snapshots are an
-                    # optimization, never a source of truth, so a stale
-                    # sidecar degrades to a rebuild on first converge.
-                    plan = None
+        with TRACER.span("checkpoint_restore", epoch=epoch.number):
+            with np.load(self._path(epoch)) as z:
+                graph = TrustGraph(
+                    n=int(z["n"]),
+                    src=z["src"],
+                    dst=z["dst"],
+                    weight=z["weight"],
+                    pre_trusted=z["pre_trusted"] if "pre_trusted" in z else None,
+                )
+                scores = np.array(z["scores"]) if "scores" in z else None
+            proof_path = self.dir / f"epoch_{epoch.number}.proof.json"
+            proof_json = proof_path.read_text() if proof_path.exists() else None
+            plan_path = self.dir / f"epoch_{epoch.number}.plan.npz"
+            plan = None
+            if plan_path.exists():
+                with np.load(plan_path) as pz:
+                    try:
+                        plan = WindowPlan.from_arrays(pz)
+                    except (ValueError, KeyError):
+                        # Plan written by an older layout version (e.g. the
+                        # pre-v2 dst-sorted boundary pairs): snapshots are an
+                        # optimization, never a source of truth, so a stale
+                        # sidecar degrades to a rebuild on first converge.
+                        plan = None
+        CHECKPOINT_RESTORES.inc()
         return Snapshot(
             epoch=epoch, graph=graph, scores=scores, proof_json=proof_json, plan=plan
         )

@@ -32,6 +32,12 @@ class ProtocolConfig:
     prover: str = "plonk"
     #: Ceremony SRS file for the PLONK prover (kzg.Setup format).
     srs_path: str | None = None
+    #: Opt-in jax.profiler capture: device-timeline traces of each
+    #: epoch's convergence land under ``<profile_dir>/epoch_<N>``
+    #: (view with tensorboard/xprof).  None disables profiling — the
+    #: default; span/metric telemetry is always on and costs no device
+    #: sync either way.
+    profile_dir: str | None = None
 
     @property
     def host(self) -> str:
@@ -56,6 +62,7 @@ class ProtocolConfig:
         cfg.checkpoint_dir = obj.get("checkpoint_dir", cfg.checkpoint_dir)
         cfg.prover = obj.get("prover", cfg.prover)
         cfg.srs_path = obj.get("srs_path", cfg.srs_path)
+        cfg.profile_dir = obj.get("profile_dir", cfg.profile_dir)
         return cfg
 
     @classmethod

@@ -22,6 +22,8 @@ import numpy as np
 from ..analysis.budget import KERNEL_INVARIANTS, NON_JAX_BACKENDS
 from ..crypto import calculate_message_hash, field
 from ..crypto.eddsa import PublicKey, sign, verify as verify_sig
+from ..obs import TRACER
+from ..obs import metrics as obs_metrics
 from ..ops.gather_window import WindowPlan
 from ..trust.backend import ConvergenceResult, get_backend
 from ..trust.graph import TrustGraph
@@ -63,6 +65,22 @@ class ManagerConfig:
     #: it the PLONK prover generates a fresh random setup at boot —
     #: sound only for verifiers who trust this node's keygen.
     srs_path: str | None = None
+
+
+@dataclass(frozen=True)
+class IngestResult:
+    """Per-item bulk-ingest outcome: acceptance plus the structural or
+    signature failure reason (the rejection-reason metric's label).
+    Truthiness mirrors acceptance so boolean-style callers keep
+    working."""
+
+    accepted: bool
+    #: Rejection reason code (``eigentrust_attestations_rejected_total``
+    #: label) — None when accepted.
+    reason: str | None = None
+
+    def __bool__(self) -> bool:
+        return self.accepted
 
 
 class Manager:
@@ -131,23 +149,28 @@ class Manager:
 
     # -- ingest ---------------------------------------------------------
 
-    def _structural_error(self, att: Attestation) -> str | None:
+    def _structural_error(self, att: Attestation) -> tuple[str, str] | None:
         """The cheap pre-signature checks, shared by both ingest paths
         (manager/mod.rs:95-138 semantics plus score conservation).
-        Returns a reason or None."""
+        Returns ``(reason code, message)`` — the code labels the
+        rejection-reason metric, the message goes into the error — or
+        None when the attestation is structurally sound."""
         # Direct pk comparison is equivalent to the reference's
         # hash-list equality (Poseidon is injective on valid points) and
         # avoids N permutations per ingest.
         if att.neighbours != self._group_pks:
-            return "neighbour group mismatch"
+            return "group-mismatch", "neighbour group mismatch"
         if att.pk not in self._group_pks:
-            return "sender not in group"
+            return "sender-not-in-group", "sender not in group"
         # Conservation precondition: the circuit's Σscores == N·IS gate
         # means a non-SCALE-summing row would poison every future epoch
         # proof; reject it at the door instead (the reference accepts it
         # and would panic at proving time, main.rs:170 unwrap).
         if sum(att.scores) != self.config.scale:
-            return f"scores must sum to {self.config.scale}"
+            return (
+                "non-conserving-scores",
+                f"scores must sum to {self.config.scale}",
+            )
         return None
 
     def add_attestation(self, att: Attestation) -> None:
@@ -155,65 +178,92 @@ class Manager:
         the neighbour list must match the group, the sender must be a
         member, and the signature must verify over the protocol message
         hash."""
-        reason = self._structural_error(att)
-        if reason is not None:
-            raise EigenError.invalid_attestation(reason)
+        error = self._structural_error(att)
+        if error is not None:
+            obs_metrics.ATTESTATIONS_REJECTED.inc(reason=error[0])
+            raise EigenError.invalid_attestation(error[1])
 
         _, message_hashes = calculate_message_hash(att.neighbours, [att.scores])
         if not self._verify_sig(att, message_hashes[0]):
+            obs_metrics.ATTESTATIONS_REJECTED.inc(reason="bad-signature")
             raise EigenError.invalid_attestation("signature verification failed")
 
+        obs_metrics.ATTESTATIONS_ACCEPTED.inc()
         self.attestations[self._pk_hash(att.pk)] = att
 
     @staticmethod
     def _verify_sig(att: Attestation, message_hash: int) -> bool:
         """EdDSA verification, preferring the C++ runtime."""
+        import time
+
         from ..crypto import native as cnative
 
-        if cnative.available():
-            return bool(
-                cnative.eddsa_verify_batch(
-                    [att.sig.big_r.x],
-                    [att.sig.big_r.y],
-                    [att.sig.s],
-                    [att.pk.point.x],
-                    [att.pk.point.y],
-                    [message_hash],
-                )[0]
-            )
-        return verify_sig(att.sig, att.pk, message_hash)
+        t0 = time.perf_counter()
+        try:
+            if cnative.available():
+                return bool(
+                    cnative.eddsa_verify_batch(
+                        [att.sig.big_r.x],
+                        [att.sig.big_r.y],
+                        [att.sig.s],
+                        [att.pk.point.x],
+                        [att.pk.point.y],
+                        [message_hash],
+                    )[0]
+                )
+            return verify_sig(att.sig, att.pk, message_hash)
+        finally:
+            obs_metrics.SIG_VERIFY_SECONDS.observe(time.perf_counter() - t0)
+            obs_metrics.SIGS_VERIFIED.inc()
 
-    def add_attestations_bulk(self, atts: list[Attestation]) -> list[bool]:
+    def add_attestations_bulk(self, atts: list[Attestation]) -> list[IngestResult]:
         """High-throughput ingest for event replay: run the shared
         structural checks per item, then batch the surviving signature
         verifications through the C++ runtime (one pass instead of A
-        scalar-muls in Python).  Returns per-item acceptance."""
+        scalar-muls in Python).  Returns a per-item
+        :class:`IngestResult` — acceptance plus the rejection reason,
+        which also feeds the rejection-reason metric."""
+        import time
+
         from ..crypto import native as cnative
 
         candidates: list[tuple[int, Attestation, int]] = []
-        accepted = [False] * len(atts)
-        for i, att in enumerate(atts):
-            if self._structural_error(att) is None:
-                _, mh = calculate_message_hash(att.neighbours, [att.scores])
-                candidates.append((i, att, mh[0]))
+        results: list[IngestResult | None] = [None] * len(atts)
+        with TRACER.span("ingest", batch=len(atts)):
+            for i, att in enumerate(atts):
+                error = self._structural_error(att)
+                if error is None:
+                    _, mh = calculate_message_hash(att.neighbours, [att.scores])
+                    candidates.append((i, att, mh[0]))
+                else:
+                    results[i] = IngestResult(False, error[0])
+                    obs_metrics.ATTESTATIONS_REJECTED.inc(reason=error[0])
 
-        if candidates and cnative.available():
-            sig_ok = cnative.eddsa_verify_batch(
-                [a.sig.big_r.x for _, a, _ in candidates],
-                [a.sig.big_r.y for _, a, _ in candidates],
-                [a.sig.s for _, a, _ in candidates],
-                [a.pk.point.x for _, a, _ in candidates],
-                [a.pk.point.y for _, a, _ in candidates],
-                [m for _, _, m in candidates],
-            )
-        else:
-            sig_ok = [verify_sig(a.sig, a.pk, m) for _, a, m in candidates]
+            t0 = time.perf_counter()
+            if candidates and cnative.available():
+                sig_ok = cnative.eddsa_verify_batch(
+                    [a.sig.big_r.x for _, a, _ in candidates],
+                    [a.sig.big_r.y for _, a, _ in candidates],
+                    [a.sig.s for _, a, _ in candidates],
+                    [a.pk.point.x for _, a, _ in candidates],
+                    [a.pk.point.y for _, a, _ in candidates],
+                    [m for _, _, m in candidates],
+                )
+            else:
+                sig_ok = [verify_sig(a.sig, a.pk, m) for _, a, m in candidates]
+            if candidates:
+                obs_metrics.SIG_VERIFY_SECONDS.observe(time.perf_counter() - t0)
+                obs_metrics.SIGS_VERIFIED.inc(len(candidates))
 
-        for (i, att, _), ok in zip(candidates, sig_ok):
-            if ok:
-                self.attestations[self._pk_hash(att.pk)] = att
-                accepted[i] = True
-        return accepted
+            for (i, att, _), ok in zip(candidates, sig_ok):
+                if ok:
+                    self.attestations[self._pk_hash(att.pk)] = att
+                    results[i] = IngestResult(True)
+                    obs_metrics.ATTESTATIONS_ACCEPTED.inc()
+                else:
+                    results[i] = IngestResult(False, "bad-signature")
+                    obs_metrics.ATTESTATIONS_REJECTED.inc(reason="bad-signature")
+        return [r for r in results if r is not None]
 
     def get_attestation(self, pk: PublicKey) -> Attestation:
         att = self.attestations.get(pk.hash())
@@ -252,7 +302,8 @@ class Manager:
         atts = [self.attestations[h] for h in self._group_hashes]
         ops = [list(a.scores) for a in atts]
         init = [cfg.initial_score] * cfg.num_neighbours
-        pub_ins = power_iterate(init, ops, cfg.num_iter, cfg.scale)
+        with TRACER.span("power_iterate"):
+            pub_ins = power_iterate(init, ops, cfg.num_iter, cfg.scale)
 
         # Constraint-level statement check before emitting the proof —
         # the reference runs MockProver::assert_satisfied inside
@@ -263,20 +314,21 @@ class Manager:
         if cfg.check_circuit:
             from ..zk.circuit import prove_epoch_statement
 
-            witness["cs"] = prove_epoch_statement(
-                atts,
-                pub_ins,
-                num_neighbours=cfg.num_neighbours,
-                num_iter=cfg.num_iter,
-                initial_score=cfg.initial_score,
-                scale=cfg.scale,
-            )
+            with TRACER.span("circuit_check"):
+                witness["cs"] = prove_epoch_statement(
+                    atts,
+                    pub_ins,
+                    num_neighbours=cfg.num_neighbours,
+                    num_iter=cfg.num_iter,
+                    initial_score=cfg.initial_score,
+                    scale=cfg.scale,
+                )
 
         # Proving time lands in telemetry, the structured analog of the
         # reference's "Proving time: {:?}" print (circuit/src/utils.rs:305-321).
         from ..utils.telemetry import TELEMETRY
 
-        with TELEMETRY.timer("epoch.prove"):
+        with TELEMETRY.timer("epoch.prove"), TRACER.span("snark"):
             proof_bytes = self.prover.prove(pub_ins, witness)
         if __debug__:
             assert self.prover.verify(pub_ins, proof_bytes)
@@ -289,7 +341,10 @@ class Manager:
         attestation and converge it on the configured TrustBackend.
         The graph used is kept as ``last_graph`` so checkpointing can
         persist exactly the graph the scores belong to."""
-        graph = self.build_graph()
+        with TRACER.span("build_graph"):
+            graph = self.build_graph()
+        obs_metrics.GRAPH_PEERS.set(graph.n)
+        obs_metrics.GRAPH_EDGES.set(graph.nnz)
         backend = get_backend(self.config.backend)
         # The analyzer (`python -m protocol_tpu.analysis`) hard-gates
         # every backend in KERNEL_INVARIANTS; a configured backend
@@ -317,6 +372,15 @@ class Manager:
             self.window_plan = backend.last_plan
         self.last_graph = graph
         self.cached_results[epoch] = result
+        # Convergence health → the /metrics surface: the iteration
+        # count, the final residual, and the full device-captured
+        # trajectory (one observation per iteration, so the histogram's
+        # per-epoch count equals the iteration count).
+        obs_metrics.CONVERGENCE_ITERATIONS.set(result.iterations)
+        obs_metrics.LAST_RESIDUAL.set(result.residual)
+        if result.residuals is not None:
+            for r in result.residuals:
+                obs_metrics.CONVERGENCE_RESIDUAL.observe(float(r))
         return result
 
     def build_graph(self) -> TrustGraph:

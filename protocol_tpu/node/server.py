@@ -21,6 +21,9 @@ from dataclasses import dataclass, field
 
 import json
 
+from ..obs import TRACER, configure_logging, prometheus_text
+from ..obs import metrics as obs_metrics
+from ..obs.export import PROMETHEUS_CONTENT_TYPE, profile_session
 from ..utils.telemetry import TELEMETRY
 from .config import ProtocolConfig
 from .epoch import Epoch
@@ -91,8 +94,36 @@ def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
             ),
             "backend": manager.config.backend,
             "telemetry": TELEMETRY.snapshot(),
+            "traced_epochs": TRACER.epochs(),
         }
         return 200, json.dumps(status)
+    if method == "GET" and path == "/metrics":
+        # Prometheus exposition format; _handle_conn switches the
+        # content type to text/plain for this path.  Never touches
+        # device state — purely the host-side registry snapshot.
+        return 200, prometheus_text()
+    if method == "GET" and path.startswith("/trace/"):
+        # /trace/<epoch> (or /trace/latest): the epoch's span tree as
+        # nested JSON (epoch_tick → prove/build_graph/plan/converge/
+        # checkpoint), serialized once at tick end — serving it is a
+        # dict copy, no sync with the epoch executor.
+        arg = path.removeprefix("/trace/")
+        if arg == "latest":
+            latest = TRACER.latest_epoch()
+            if latest is None:
+                return NOT_FOUND, json.dumps({"error": "no epochs traced yet"})
+            arg = str(latest)
+        try:
+            epoch_number = int(arg)
+        except ValueError:
+            return BAD_REQUEST, "InvalidQuery"
+        trace = TRACER.get_trace(epoch_number)
+        if trace is None:
+            return NOT_FOUND, json.dumps(
+                {"error": f"no trace for epoch {epoch_number}",
+                 "traced_epochs": TRACER.epochs()}
+            )
+        return 200, json.dumps(trace)
     return NOT_FOUND, "InvalidRequest"
 
 
@@ -142,10 +173,15 @@ class Node:
                 else:
                     status, body = handle_request(parts[0], parts[1], self.manager)
             payload = body.encode()
+            content_type = (
+                PROMETHEUS_CONTENT_TYPE
+                if len(parts) >= 2 and parts[1].split("?", 1)[0] == "/metrics"
+                else "application/json"
+            )
             writer.write(
                 (
                     f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}\r\n"
-                    f"content-type: application/json\r\n"
+                    f"content-type: {content_type}\r\n"
                     f"content-length: {len(payload)}\r\n"
                     f"connection: close\r\n\r\n"
                 ).encode()
@@ -160,53 +196,84 @@ class Node:
     def _epoch_tick(self, epoch: Epoch) -> None:
         """One epoch of work: the fixed-set proof (reference parity) and,
         on a TPU backend, open-graph convergence at scale; snapshots the
-        assembled graph + scores when a checkpoint dir is configured."""
-        with TELEMETRY.timer("epoch.calculate_proofs"):
-            self.manager.calculate_proofs(epoch)
-        scores = None
-        if self.manager.config.backend != "native-cpu":
-            with TELEMETRY.timer("epoch.converge_open_graph"):
-                result = self.manager.converge_epoch(epoch, alpha=0.1)
-            scores = result.scores
-            log.info(
-                "epoch %s: open graph n=%d converged in %d iters (resid %.2e) on %s",
-                epoch,
-                len(result.scores),
-                result.iterations,
-                result.residual,
-                result.backend,
-            )
-        if self.config.checkpoint_dir:
-            from .checkpoint import CheckpointStore
+        assembled graph + scores when a checkpoint dir is configured.
 
-            # Persist exactly the graph the scores were computed on
-            # (ingest keeps mutating the attestation cache concurrently;
-            # a rebuilt graph could have more peers than scores).
-            graph = self.manager.last_graph if scores is not None else self.manager.build_graph()
-            proof_json = (
-                self.manager.get_proof(epoch)
-                .to_raw(backend=_backend_tag(self.manager))
-                .to_json()
-            )
-            with TELEMETRY.timer("epoch.checkpoint"):
-                CheckpointStore(self.config.checkpoint_dir).save(
-                    epoch,
-                    graph,
-                    scores,
-                    proof_json,
-                    # tpu-windowed only: the one-time bucketing plan, so
-                    # a reboot revalidates instead of rebuilding it.
-                    plan=self.manager.window_plan,
+        The whole tick runs under the epoch's trace root
+        (``epoch_tick`` → prove → build_graph → plan → converge →
+        checkpoint): spans open and close only at these host
+        boundaries, so the tree costs a few context-manager entries per
+        epoch and nothing inside the jit'd loop."""
+        with TRACER.epoch(epoch.number):
+            with TELEMETRY.timer("epoch.calculate_proofs"), TRACER.span("prove"):
+                self.manager.calculate_proofs(epoch)
+            scores = None
+            if self.manager.config.backend != "native-cpu":
+                # Opt-in jax.profiler session (ProtocolConfig.profile_dir):
+                # a device-timeline capture of exactly the convergence
+                # region, epoch-tagged subdirectories so ticks don't
+                # overwrite each other.
+                profile_dir = (
+                    f"{self.config.profile_dir}/epoch_{epoch.number}"
+                    if self.config.profile_dir
+                    else None
                 )
+                with TELEMETRY.timer("epoch.converge_open_graph"):
+                    with profile_session(profile_dir):
+                        result = self.manager.converge_epoch(epoch, alpha=0.1)
+                scores = result.scores
+                log.info(
+                    "epoch %s: open graph n=%d converged in %d iters (resid %.2e) on %s",
+                    epoch,
+                    len(result.scores),
+                    result.iterations,
+                    result.residual,
+                    result.backend,
+                )
+            if self.config.checkpoint_dir:
+                from .checkpoint import CheckpointStore
+
+                # Persist exactly the graph the scores were computed on
+                # (ingest keeps mutating the attestation cache concurrently;
+                # a rebuilt graph could have more peers than scores).
+                graph = self.manager.last_graph if scores is not None else self.manager.build_graph()
+                proof_json = (
+                    self.manager.get_proof(epoch)
+                    .to_raw(backend=_backend_tag(self.manager))
+                    .to_json()
+                )
+                with TELEMETRY.timer("epoch.checkpoint"), TRACER.span("checkpoint"):
+                    CheckpointStore(self.config.checkpoint_dir).save(
+                        epoch,
+                        graph,
+                        scores,
+                        proof_json,
+                        # tpu-windowed only: the one-time bucketing plan, so
+                        # a reboot revalidates instead of rebuilding it.
+                        plan=self.manager.window_plan,
+                    )
         TELEMETRY.count("epochs")
+        obs_metrics.EPOCHS_TOTAL.inc()
 
     async def _epoch_loop(self, warm=None):
         if warm is not None:
             await warm  # boot keygen must land before the first prove
         interval = self.config.epoch_interval
+        last_epoch: int | None = None
         while True:
             await asyncio.sleep(Epoch.secs_until_next_epoch(interval))
             epoch = Epoch.current_epoch(interval)
+            # Skip semantics drop boundaries a long tick overran; make
+            # the drops countable instead of silent (the gap between
+            # consecutively processed epochs is exactly the drop count).
+            if last_epoch is not None and epoch.number > last_epoch + 1:
+                dropped = epoch.number - last_epoch - 1
+                obs_metrics.EPOCH_TICKS_DROPPED.inc(dropped)
+                log.warning(
+                    "epoch %s: dropped %d epoch tick(s) (previous tick overran)",
+                    epoch,
+                    dropped,
+                )
+            last_epoch = epoch.number
             try:
                 # Proving may outlast the interval; the next sleep
                 # targets the *next* boundary from now = Skip semantics.
@@ -308,7 +375,11 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="protocol_tpu node")
     parser.add_argument("--config", default="data/protocol-config.json")
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # Single logging entry point (obs.configure_logging): installs the
+    # span-aware handler only when the embedding application hasn't
+    # configured the root logger already, and stamps every record with
+    # the current epoch/span ids either way.
+    configure_logging(level=logging.INFO)
     config = ProtocolConfig.load(args.config)
     asyncio.run(Node.from_config(config).run_forever())
 

@@ -1,0 +1,60 @@
+"""Node-wide observability: trace spans, metrics, exporters.
+
+The structured successor of the bare ``utils.telemetry`` timers: one
+coherent instrumentation layer threaded through ingest, convergence,
+proving, checkpointing, and serving.  Three pieces:
+
+- :mod:`~protocol_tpu.obs.trace` — hierarchical spans (context
+  managers, monotonic timing, contextvar nesting) collected into a
+  per-epoch span tree the node serves as ``GET /trace/<epoch>``;
+- :mod:`~protocol_tpu.obs.metrics` — a thread-safe registry of
+  counters/gauges/histograms (ingest accept/reject by reason,
+  sig-verify throughput, iterations-to-convergence, the per-iteration
+  residual trajectory, dropped epoch ticks, checkpoint and
+  window-plan events) served as ``GET /metrics``;
+- :mod:`~protocol_tpu.obs.export` — Prometheus text + JSON renderers
+  and the opt-in ``jax.profiler`` session hook.
+
+Doctrine (enforced by graftlint pass 3, ``analysis/ast_rules.py``):
+spans and metrics live at *host boundaries only*.  Nothing here may be
+called from inside a jit-traced function, and the per-iteration
+residual trajectory is captured device-side in the ``lax.while_loop``
+carry (``ops.sparse.run_power_iteration``) and fetched ONCE after
+convergence — the hot loop never syncs, logs, or reads a clock.
+
+This package imports only the standard library, so instrumenting a
+module costs nothing at import time.
+"""
+
+from __future__ import annotations
+
+from . import metrics as _metrics
+from .export import metrics_json, profile_session, prometheus_text
+from .metrics import METRICS, MetricsRegistry
+from .trace import (
+    TRACER,
+    Span,
+    SpanContextFilter,
+    Tracer,
+    configure_logging,
+)
+
+# Every closed span feeds the phase-seconds histogram, so span timings
+# (plan, converge, prove, checkpoint, sig_verify, ...) are scrapeable
+# without separate timer plumbing at each site.
+TRACER.on_span_close = lambda span: _metrics.PHASE_SECONDS.observe(
+    span.duration_s or 0.0, phase=span.name
+)
+
+__all__ = [
+    "METRICS",
+    "MetricsRegistry",
+    "Span",
+    "SpanContextFilter",
+    "TRACER",
+    "Tracer",
+    "configure_logging",
+    "metrics_json",
+    "profile_session",
+    "prometheus_text",
+]

@@ -1,0 +1,132 @@
+"""Exporters: Prometheus text format, JSON, and the jax.profiler hook.
+
+``prometheus_text`` renders the registry in the Prometheus exposition
+format (text/plain; version=0.0.4) the node serves at ``GET /metrics``;
+``metrics_json`` is the same state for tooling that prefers JSON.
+``profile_session`` is the opt-in device-timeline capture around
+``converge_epoch`` (``ProtocolConfig.profile_dir``): it wraps
+``jax.profiler.trace`` and degrades to a no-op when jax is absent, so
+importing this module never touches the device runtime.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import Any, Iterator
+
+from .metrics import METRICS, Histogram, Metric, MetricsRegistry
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels(names: tuple[str, ...], values: tuple[str, ...], extra: str = "") -> str:
+    pairs = [
+        f'{n}="{_escape_label_value(v)}"' for n, v in zip(names, values)
+    ]
+    if extra:
+        pairs.append(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _fmt(value: float) -> str:
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    if float(value).is_integer() and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _render_metric(metric: Metric) -> list[str]:
+    lines = []
+    if metric.help:
+        lines.append(f"# HELP {metric.name} {metric.help}")
+    lines.append(f"# TYPE {metric.name} {metric.kind}")
+    if isinstance(metric, Histogram):
+        snap = metric.snapshot()
+        if not snap:
+            # An unobserved histogram still advertises its series.
+            snap = {
+                tuple("" for _ in metric.labelnames): {
+                    "buckets": [0] * len(metric.bucket_bounds),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            }
+        for labelvalues, state in snap.items():
+            for bound, count in zip(metric.bucket_bounds, state["buckets"]):
+                le = f'le="{_fmt(bound)}"'
+                lines.append(
+                    f"{metric.name}_bucket"
+                    f"{_labels(metric.labelnames, labelvalues, le)} {count}"
+                )
+            lines.append(
+                f"{metric.name}_sum"
+                f"{_labels(metric.labelnames, labelvalues)} {_fmt(state['sum'])}"
+            )
+            lines.append(
+                f"{metric.name}_count"
+                f"{_labels(metric.labelnames, labelvalues)} {state['count']}"
+            )
+        return lines
+    samples = metric.samples()
+    if not samples and not metric.labelnames:
+        samples = [((), 0.0)]
+    for labelvalues, value in samples:
+        lines.append(
+            f"{metric.name}{_labels(metric.labelnames, labelvalues)} {_fmt(value)}"
+        )
+    return lines
+
+
+#: Content type of the exposition format, for HTTP servers.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def prometheus_text(registry: MetricsRegistry | None = None) -> str:
+    """The full registry in Prometheus exposition format."""
+    registry = registry if registry is not None else METRICS
+    lines: list[str] = []
+    for metric in registry.collect():
+        lines.extend(_render_metric(metric))
+    return "\n".join(lines) + "\n"
+
+
+def metrics_json(registry: MetricsRegistry | None = None) -> dict[str, Any]:
+    """JSON-ready snapshot: metric name -> {kind, help, state}."""
+    registry = registry if registry is not None else METRICS
+    return {
+        metric.name: {"kind": metric.kind, "help": metric.help, **metric.to_dict()}
+        for metric in registry.collect()
+    }
+
+
+@contextlib.contextmanager
+def profile_session(log_dir: str | None) -> Iterator[None]:
+    """Opt-in ``jax.profiler`` capture: a real device-timeline trace
+    (view with tensorboard/xprof) around the wrapped region when
+    ``log_dir`` is set; a no-op context when it is None or jax is
+    missing.  The node wraps ``converge_epoch`` with this when
+    ``ProtocolConfig.profile_dir`` is configured."""
+    if not log_dir:
+        yield
+        return
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - jax ships in every image
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+__all__ = [
+    "PROMETHEUS_CONTENT_TYPE",
+    "metrics_json",
+    "profile_session",
+    "prometheus_text",
+]

@@ -1,0 +1,359 @@
+"""Thread-safe metrics registry: counters, gauges, histograms.
+
+The node's scrapeable state (``GET /metrics``), recorded from executor
+threads (epoch ticks), the asyncio event loop (ingest), and read by
+concurrent HTTP scrapes — one registry lock serializes every mutation
+and snapshot, and all record calls are O(labels) dict work, so nothing
+here belongs anywhere near a device loop (graftlint pass 3 enforces
+that structurally).
+
+Metric shapes follow the Prometheus data model so
+:func:`protocol_tpu.obs.export.prometheus_text` renders them without
+translation: counters are monotonic (``_total`` names), gauges are
+set-to-current, histograms are cumulative-bucket with ``_sum`` and
+``_count`` series.  The per-iteration convergence residuals — captured
+device-side in the ``lax.while_loop`` carry and fetched once after
+convergence — land in :data:`CONVERGENCE_RESIDUAL`, whose per-epoch
+observation count therefore equals the iteration count.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Iterable
+
+LabelValues = tuple[str, ...]
+
+
+class Metric:
+    """Base: name, help text, label names, per-labelset values."""
+
+    kind = "untyped"
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        labelnames: tuple[str, ...],
+        lock: threading.Lock,
+    ):
+        self.name = name
+        self.help = help_text
+        self.labelnames = labelnames
+        self._lock = lock
+
+    def _label_key(self, labels: dict[str, Any]) -> LabelValues:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def samples(self) -> list[tuple[LabelValues, float]]:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict[str, Any]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonic counter, optionally labelled (e.g. rejection reason)."""
+
+    kind = "counter"
+
+    def __init__(self, name, help_text, labelnames, lock):
+        super().__init__(name, help_text, labelnames, lock)
+        self._values: dict[LabelValues, float] = {}
+
+    def inc(self, n: float = 1, **labels: Any) -> None:
+        if n < 0:
+            raise ValueError(f"{self.name}: counters only go up (n={n})")
+        key = self._label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels: Any) -> float:
+        key = self._label_key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def samples(self):
+        with self._lock:
+            return sorted(self._values.items())
+
+    def to_dict(self):
+        with self._lock:
+            if not self.labelnames:
+                return {"value": self._values.get((), 0.0)}
+            return {
+                "values": {
+                    ",".join(k): v for k, v in sorted(self._values.items())
+                }
+            }
+
+
+class Gauge(Metric):
+    """Set-to-current value (iterations of the last epoch, graph size)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help_text, labelnames, lock):
+        super().__init__(name, help_text, labelnames, lock)
+        self._values: dict[LabelValues, float] = {}
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._label_key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def value(self, **labels: Any) -> float:
+        key = self._label_key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def samples(self):
+        with self._lock:
+            return sorted(self._values.items())
+
+    def to_dict(self):
+        return Counter.to_dict(self)  # same shape
+
+
+class _HistState:
+    __slots__ = ("bucket_counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.bucket_counts = [0] * n_buckets
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(Metric):
+    """Cumulative-bucket histogram (Prometheus semantics): ``observe``
+    increments every bucket whose upper bound is >= the value, plus
+    ``_sum``/``_count``."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help_text, labelnames, lock, buckets: Iterable[float]):
+        super().__init__(name, help_text, labelnames, lock)
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds:
+            raise ValueError(f"{self.name}: histogram needs buckets")
+        if bounds[-1] != math.inf:
+            bounds.append(math.inf)
+        self.bucket_bounds: tuple[float, ...] = tuple(bounds)
+        self._states: dict[LabelValues, _HistState] = {}
+
+    def observe(self, value: float, **labels: Any) -> None:
+        value = float(value)
+        key = self._label_key(labels)
+        with self._lock:
+            state = self._states.get(key)
+            if state is None:
+                state = self._states[key] = _HistState(len(self.bucket_bounds))
+            for i, bound in enumerate(self.bucket_bounds):
+                if value <= bound:
+                    state.bucket_counts[i] += 1
+            state.sum += value
+            state.count += 1
+
+    def count(self, **labels: Any) -> int:
+        key = self._label_key(labels)
+        with self._lock:
+            state = self._states.get(key)
+            return state.count if state is not None else 0
+
+    def snapshot(self) -> dict[LabelValues, dict[str, Any]]:
+        with self._lock:
+            return {
+                k: {
+                    "buckets": list(s.bucket_counts),
+                    "sum": s.sum,
+                    "count": s.count,
+                }
+                for k, s in sorted(self._states.items())
+            }
+
+    def samples(self):  # _count series, for uniform JSON summaries
+        with self._lock:
+            return sorted((k, float(s.count)) for k, s in self._states.items())
+
+    def to_dict(self):
+        return {
+            "buckets": [b if b != math.inf else "+Inf" for b in self.bucket_bounds],
+            "values": {",".join(k): v for k, v in self.snapshot().items()},
+        }
+
+
+class MetricsRegistry:
+    """Registry with idempotent constructors: calling ``counter(name)``
+    twice returns the same instance (so instrumented modules don't need
+    import-order coordination), but re-registering a name as a
+    different kind is an error."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Metric] = {}
+
+    def _register(self, cls, name: str, help_text: str, labelnames, **kw) -> Any:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls or existing.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}{existing.labelnames}"
+                    )
+                return existing
+            metric = cls(name, help_text, labelnames, self._lock, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(
+        self, name: str, help_text: str = "", labelnames: Iterable[str] = ()
+    ) -> Counter:
+        return self._register(Counter, name, help_text, labelnames)
+
+    def gauge(
+        self, name: str, help_text: str = "", labelnames: Iterable[str] = ()
+    ) -> Gauge:
+        return self._register(Gauge, name, help_text, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help_text: str = "",
+        labelnames: Iterable[str] = (),
+        buckets: Iterable[float] = (),
+    ) -> Histogram:
+        return self._register(
+            Histogram, name, help_text, labelnames, buckets=buckets or TIME_BUCKETS
+        )
+
+    def collect(self) -> list[Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def reset(self) -> None:
+        """Zero every metric (tests).  Registrations survive — only the
+        recorded values clear."""
+        for metric in self.collect():
+            with self._lock:
+                if isinstance(metric, Histogram):
+                    metric._states.clear()
+                else:
+                    metric._values.clear()  # type: ignore[attr-defined]
+
+
+#: Span/phase durations in seconds (node epoch phases, sig-verify, ...).
+TIME_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+#: Convergence residuals: log-spaced around typical tol values.
+RESIDUAL_BUCKETS = (
+    1e-9, 1e-8, 1e-7, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0,
+)
+
+#: Process-global registry (the node's /metrics source).
+METRICS = MetricsRegistry()
+
+# -- the node's metric catalog (README "Observability") ---------------------
+
+ATTESTATIONS_ACCEPTED = METRICS.counter(
+    "eigentrust_attestations_accepted_total",
+    "Attestations that passed structural + signature checks",
+)
+ATTESTATIONS_REJECTED = METRICS.counter(
+    "eigentrust_attestations_rejected_total",
+    "Attestations rejected at ingest, by reason",
+    labelnames=("reason",),
+)
+SIGS_VERIFIED = METRICS.counter(
+    "eigentrust_signatures_verified_total",
+    "EdDSA signatures checked (accepted or not)",
+)
+SIG_VERIFY_SECONDS = METRICS.histogram(
+    "eigentrust_sig_verify_seconds",
+    "Wall-clock of signature verification calls (batched or single)",
+    buckets=TIME_BUCKETS,
+)
+CONVERGENCE_ITERATIONS = METRICS.gauge(
+    "eigentrust_convergence_iterations",
+    "Power iterations the last open-graph convergence took",
+)
+CONVERGENCE_RESIDUAL = METRICS.histogram(
+    "eigentrust_convergence_residual",
+    "Per-iteration L1 residuals, captured device-side in the loop "
+    "carry and fetched once after convergence",
+    buckets=RESIDUAL_BUCKETS,
+)
+LAST_RESIDUAL = METRICS.gauge(
+    "eigentrust_last_residual",
+    "Final L1 residual of the last open-graph convergence",
+)
+GRAPH_PEERS = METRICS.gauge(
+    "eigentrust_graph_peers", "Peers in the last assembled trust graph"
+)
+GRAPH_EDGES = METRICS.gauge(
+    "eigentrust_graph_edges", "Edges in the last assembled trust graph"
+)
+EPOCHS_TOTAL = METRICS.counter(
+    "eigentrust_epochs_total", "Epoch ticks completed"
+)
+EPOCH_TICKS_DROPPED = METRICS.counter(
+    "eigentrust_epoch_ticks_dropped_total",
+    "Epoch boundaries skipped because the previous tick overran "
+    "(Skip missed-tick semantics)",
+)
+CHECKPOINT_SAVES = METRICS.counter(
+    "eigentrust_checkpoint_saves_total", "Checkpoint snapshots written"
+)
+CHECKPOINT_RESTORES = METRICS.counter(
+    "eigentrust_checkpoint_restores_total", "Checkpoint snapshots loaded"
+)
+PLAN_REBUILDS = METRICS.counter(
+    "eigentrust_window_plan_rebuilds_total",
+    "WindowPlan constructions (cold, fingerprint miss, or stale layout)",
+)
+PLAN_REUSES = METRICS.counter(
+    "eigentrust_window_plan_reuses_total",
+    "Converges that reused a cached/restored WindowPlan",
+)
+PHASE_SECONDS = METRICS.histogram(
+    "eigentrust_phase_seconds",
+    "Span durations by phase name (every closed obs span lands here)",
+    labelnames=("phase",),
+    buckets=TIME_BUCKETS,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "METRICS",
+    "Metric",
+    "MetricsRegistry",
+    "RESIDUAL_BUCKETS",
+    "TIME_BUCKETS",
+    "ATTESTATIONS_ACCEPTED",
+    "ATTESTATIONS_REJECTED",
+    "SIGS_VERIFIED",
+    "SIG_VERIFY_SECONDS",
+    "CONVERGENCE_ITERATIONS",
+    "CONVERGENCE_RESIDUAL",
+    "LAST_RESIDUAL",
+    "GRAPH_PEERS",
+    "GRAPH_EDGES",
+    "EPOCHS_TOTAL",
+    "EPOCH_TICKS_DROPPED",
+    "CHECKPOINT_SAVES",
+    "CHECKPOINT_RESTORES",
+    "PLAN_REBUILDS",
+    "PLAN_REUSES",
+    "PHASE_SECONDS",
+]

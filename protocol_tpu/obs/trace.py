@@ -1,0 +1,232 @@
+"""Hierarchical trace spans with per-epoch span trees.
+
+A span is a context manager timing one host-side phase; nesting is
+tracked through a :mod:`contextvars` variable, so spans opened anywhere
+down the call stack (manager → backend → checkpoint store) attach to
+the right parent without plumbing, and concurrent epoch ticks in
+executor threads keep independent stacks.
+
+``Tracer.epoch(n)`` opens the per-epoch root span (``epoch_tick``) and,
+on exit, freezes the tree into a JSON-ready dict the node serves as
+``GET /trace/<epoch>``.  Spans opened with no enclosing root are still
+timed (and fed to ``on_span_close``, which the package wires into the
+phase-seconds histogram) but belong to no stored trace — ingest spans
+on the event loop work this way.
+
+Spans must only wrap host-boundary work: graftlint pass 3
+(``analysis/ast_rules.py``) rejects clock and logging calls inside
+jit-traced functions, so a span can never sneak a host sync into the
+device loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+#: The innermost open span of the current thread/task, or None.
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "protocol_tpu_obs_span", default=None
+)
+#: The epoch whose root span is open on this thread/task, or None.
+_current_epoch: contextvars.ContextVar["int | None"] = contextvars.ContextVar(
+    "protocol_tpu_obs_epoch", default=None
+)
+
+#: Process-wide span id source (CPython-atomic C iterator).
+_span_ids = itertools.count(1)
+
+
+@dataclass
+class Span:
+    """One timed phase.  ``duration_s`` is None while the span is open."""
+
+    name: str
+    span_id: int
+    attrs: dict[str, Any] = field(default_factory=dict)
+    children: list["Span"] = field(default_factory=list)
+    #: Monotonic start (for durations) and offset from the root span's
+    #: start (for ordering inside a serialized tree).
+    start_monotonic: float = 0.0
+    start_offset_s: float = 0.0
+    duration_s: float | None = None
+
+    def child_names(self) -> list[str]:
+        return [c.name for c in self.children]
+
+    def find(self, name: str) -> "Span | None":
+        """First descendant (depth-first) with the given name."""
+        for c in self.children:
+            if c.name == name:
+                return c
+            hit = c.find(name)
+            if hit is not None:
+                return hit
+        return None
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "name": self.name,
+            "span_id": self.span_id,
+            "start_offset_s": round(self.start_offset_s, 6),
+            "duration_s": round(self.duration_s, 6)
+            if self.duration_s is not None
+            else None,
+        }
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        out["children"] = [c.to_dict() for c in self.children]
+        return out
+
+
+class Tracer:
+    """Span factory + per-epoch trace store.
+
+    Thread-safe: spans nest per-thread via contextvars; the finished
+    trace dicts live behind a lock so HTTP scrapes and epoch ticks can
+    race freely.
+    """
+
+    def __init__(self, keep_epochs: int = 16):
+        self.keep_epochs = keep_epochs
+        self._traces: dict[int, dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        #: Called with every closed span (package wiring feeds the
+        #: phase-seconds histogram).  Must be cheap and never raise.
+        self.on_span_close: Callable[[Span], None] | None = None
+
+    # -- spans ----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Span]:
+        parent = _current_span.get()
+        now = time.monotonic()
+        root_start = parent.start_monotonic - parent.start_offset_s if parent else now
+        sp = Span(
+            name=name,
+            span_id=next(_span_ids),
+            attrs=attrs,
+            start_monotonic=now,
+            start_offset_s=now - root_start,
+        )
+        if parent is not None:
+            parent.children.append(sp)
+        token = _current_span.set(sp)
+        try:
+            yield sp
+        finally:
+            sp.duration_s = time.monotonic() - sp.start_monotonic
+            _current_span.reset(token)
+            hook = self.on_span_close
+            if hook is not None:
+                try:
+                    hook(sp)
+                except Exception:  # noqa: BLE001 - observability never throws
+                    pass
+
+    @contextlib.contextmanager
+    def epoch(self, epoch_number: int) -> Iterator[Span]:
+        """Open the per-epoch root span (``epoch_tick``) and store the
+        serialized tree on exit — including on exception, so a failed
+        tick still leaves its partial trace behind."""
+        epoch_number = int(epoch_number)
+        token = _current_epoch.set(epoch_number)
+        root: Span | None = None
+        try:
+            with self.span("epoch_tick", epoch=epoch_number) as root:
+                try:
+                    yield root
+                except BaseException:
+                    root.attrs["error"] = True
+                    raise
+        finally:
+            _current_epoch.reset(token)
+            if root is not None:
+                with self._lock:
+                    self._traces[epoch_number] = root.to_dict()
+                    while len(self._traces) > self.keep_epochs:
+                        del self._traces[min(self._traces)]
+
+    # -- queries --------------------------------------------------------
+
+    def get_trace(self, epoch_number: int) -> dict[str, Any] | None:
+        with self._lock:
+            trace = self._traces.get(int(epoch_number))
+            return dict(trace) if trace is not None else None
+
+    def latest_epoch(self) -> int | None:
+        with self._lock:
+            return max(self._traces) if self._traces else None
+
+    def epochs(self) -> list[int]:
+        with self._lock:
+            return sorted(self._traces)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+#: Process-global tracer (the node's /trace source).
+TRACER = Tracer()
+
+
+# ---------------------------------------------------------------------------
+# Logging integration
+# ---------------------------------------------------------------------------
+
+#: Log format with the span/epoch context columns the filter injects.
+LOG_FORMAT = (
+    "%(asctime)s %(name)s %(levelname)s "
+    "[epoch=%(epoch)s span=%(span)s] %(message)s"
+)
+
+
+class SpanContextFilter(logging.Filter):
+    """Stamps every record with ``epoch``/``span``/``span_id`` from the
+    current trace context, so any formatter may reference them."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        span = _current_span.get()
+        epoch = _current_epoch.get()
+        record.epoch = "-" if epoch is None else epoch
+        record.span = span.name if span is not None else "-"
+        record.span_id = span.span_id if span is not None else 0
+        return True
+
+
+def configure_logging(level: int = logging.INFO) -> None:
+    """Single logging entry point for the node (and anything embedding
+    it).  Unlike a bare ``logging.basicConfig``, this respects an
+    existing root handler: it only *attaches* the span-context filter
+    (so the host application's own format can use ``%(epoch)s`` /
+    ``%(span)s``) and never installs a second handler or clobbers the
+    existing formatter.  On a pristine root it installs one stream
+    handler with :data:`LOG_FORMAT`."""
+    root = logging.getLogger()
+    if root.handlers:
+        for handler in root.handlers:
+            if not any(isinstance(f, SpanContextFilter) for f in handler.filters):
+                handler.addFilter(SpanContextFilter())
+        return
+    handler = logging.StreamHandler()
+    handler.addFilter(SpanContextFilter())
+    handler.setFormatter(logging.Formatter(LOG_FORMAT))
+    root.addHandler(handler)
+    root.setLevel(level)
+
+
+__all__ = [
+    "LOG_FORMAT",
+    "Span",
+    "SpanContextFilter",
+    "TRACER",
+    "Tracer",
+    "configure_logging",
+]

@@ -588,7 +588,10 @@ def power_step_windowed(
 
 @partial(
     jax.jit,
-    static_argnames=("n_rows", "table_entries", "tol", "max_iter", "interpret"),
+    static_argnames=(
+        "n_rows", "table_entries", "tol", "max_iter", "interpret",
+        "record_residuals",
+    ),
     donate_argnames=("t0",),
 )
 def converge_windowed(
@@ -609,11 +612,15 @@ def converge_windowed(
     tol: float = 1e-6,
     max_iter: int = 50,
     interpret: bool = False,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    record_residuals: bool = False,
+) -> tuple[jax.Array, ...]:
     """Fused-pipeline analog of ``converge_csr`` — same shared
     ``run_power_iteration`` driver, so early-exit semantics can't drift
     between formulations.  ``t0`` is donated (pass a fresh buffer);
-    the plan arrays are not — they are reused across epochs."""
+    the plan arrays are not — they are reused across epochs.
+    ``record_residuals`` appends the device-side residual history to
+    the returned tuple (the telemetry path; no host sync, no new
+    gathers — see ``run_power_iteration``)."""
     return run_power_iteration(
         lambda t: power_step_windowed(
             wid,
@@ -634,6 +641,7 @@ def converge_windowed(
         t0,
         tol=tol,
         max_iter=max_iter,
+        record_residuals=record_residuals,
     )
 
 

@@ -142,31 +142,69 @@ def power_step_csr(
     return t_new / jnp.sum(t_new)
 
 
-def run_power_iteration(step_fn, t0: jax.Array, *, tol: float, max_iter: int):
+def run_power_iteration(
+    step_fn, t0: jax.Array, *, tol: float, max_iter: int,
+    record_residuals: bool = False,
+):
     """Shared on-device convergence driver: iterate ``step_fn`` under
     while_loop until the L1 residual drops below ``tol`` (or fori_loop
     for exactly ``max_iter`` fixed steps when ``tol <= 0``, the
     benchmark mode).  Used by every sparse/sharded convergence kernel so
-    early-exit semantics can't drift between formulations."""
+    early-exit semantics can't drift between formulations.
+
+    With ``record_residuals`` the loop additionally carries a
+    ``(max_iter,)`` residual-history vector and writes each iteration's
+    L1 residual into it *device-side* (``lax.dynamic_update_slice`` on
+    the carry — not a scatter, not a callback, no host sync; the
+    telemetry contract ``tests/test_obs.py`` pins against the jaxpr),
+    returning ``(t, iterations, residual, history)``; callers fetch the
+    history ONCE after convergence and slice ``history[:iterations]``.
+    The score arithmetic is the identical op sequence either way, so
+    instrumented and uninstrumented runs are bit-identical."""
 
     def cond(state):
-        t, prev, it = state
-        resid = jnp.sum(jnp.abs(t - prev))
+        it = state[2]
+        if record_residuals:
+            # The body already reduced this iteration's residual for
+            # the history write; reuse the carried scalar instead of
+            # re-reducing — identical value, one O(n) pass per
+            # iteration either way.
+            resid = state[4]
+        else:
+            t, prev = state[0], state[1]
+            resid = jnp.sum(jnp.abs(t - prev))
         return (it < max_iter) & ((it == 0) | (resid > tol))
 
     def body(state):
-        t, _, it = state
-        return (step_fn(t), t, it + 1)
+        t, _, it = state[:3]
+        t_new = step_fn(t)
+        if not record_residuals:
+            return (t_new, t, it + 1)
+        resid = jnp.sum(jnp.abs(t_new - t))
+        hist = lax.dynamic_update_index_in_dim(state[3], resid, it, 0)
+        return (t_new, t, it + 1, hist, resid)
 
     init = (t0, jnp.full_like(t0, jnp.inf), jnp.array(0, jnp.int32))
+    if record_residuals:
+        init = init + (
+            jnp.zeros(max_iter, t0.dtype),
+            jnp.array(jnp.inf, t0.dtype),
+        )
     if tol <= 0:
-        t, prev, it = lax.fori_loop(0, max_iter, lambda _, s: body(s), init)
+        out = lax.fori_loop(0, max_iter, lambda _, s: body(s), init)
     else:
-        t, prev, it = lax.while_loop(cond, body, init)
+        out = lax.while_loop(cond, body, init)
+    t, prev, it = out[:3]
+    if record_residuals:
+        return t, it, out[4], out[3]
     return t, it, jnp.sum(jnp.abs(t - prev))
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter"), donate_argnames=("t0",))
+@partial(
+    jax.jit,
+    static_argnames=("tol", "max_iter", "record_residuals"),
+    donate_argnames=("t0",),
+)
 def converge_csr(
     src: jax.Array,
     row_ptr: jax.Array,
@@ -178,15 +216,19 @@ def converge_csr(
     alpha: jax.Array | float = 0.1,
     tol: float = 1e-6,
     max_iter: int = 50,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    record_residuals: bool = False,
+) -> tuple[jax.Array, ...]:
     """CSR/cumsum analog of ``converge_sparse``.  ``t0`` is donated:
     the iteration consumes the initial vector in place (4 MB saved at
-    the 1M-peer shape), so callers must pass a fresh buffer."""
+    the 1M-peer shape), so callers must pass a fresh buffer.
+    ``record_residuals`` appends the device-side residual history to
+    the returned tuple (see ``run_power_iteration``)."""
     return run_power_iteration(
         lambda t: power_step_csr(src, row_ptr, w, t, p, dangling, alpha),
         t0,
         tol=tol,
         max_iter=max_iter,
+        record_residuals=record_residuals,
     )
 
 
@@ -216,7 +258,7 @@ def power_step_coo(
 
 @partial(
     jax.jit,
-    static_argnames=("n", "tol", "max_iter", "sorted_by_dst"),
+    static_argnames=("n", "tol", "max_iter", "sorted_by_dst", "record_residuals"),
     donate_argnames=("t0",),
 )
 def converge_sparse(
@@ -232,12 +274,14 @@ def converge_sparse(
     tol: float = 1e-6,
     max_iter: int = 50,
     sorted_by_dst: bool = True,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    record_residuals: bool = False,
+) -> tuple[jax.Array, ...]:
     """Iterate to an L1 fixed point; returns ``(t, iterations,
     residual)``.  ``tol <= 0`` runs exactly ``max_iter`` steps (the
     benchmarking mode — fixed work, no early exit).  ``alpha`` is a
     traced operand so damping sweeps reuse one compiled kernel.
-    ``t0`` is donated — pass a fresh buffer."""
+    ``t0`` is donated — pass a fresh buffer.  ``record_residuals``
+    appends the device-side residual history to the returned tuple."""
     return run_power_iteration(
         lambda t: power_step_coo(
             src, dst, w, t, p, dangling, alpha, n=n, sorted_by_dst=sorted_by_dst
@@ -245,6 +289,7 @@ def converge_sparse(
         t0,
         tol=tol,
         max_iter=max_iter,
+        record_residuals=record_residuals,
     )
 
 

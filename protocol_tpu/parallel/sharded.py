@@ -172,8 +172,11 @@ def _get_runner(mesh: Mesh, n: int):
         t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
         return t_new / jnp.sum(t_new)
 
-    @partial(jax.jit, static_argnames=("max_iter", "tol"))
-    def run(src, w, row_ptr, t0, p, dangling, alpha, *, max_iter, tol):
+    @partial(jax.jit, static_argnames=("max_iter", "tol", "record_residuals"))
+    def run(
+        src, w, row_ptr, t0, p, dangling, alpha,
+        *, max_iter, tol, record_residuals=False,
+    ):
         from ..ops.sparse import run_power_iteration
 
         return run_power_iteration(
@@ -181,6 +184,7 @@ def _get_runner(mesh: Mesh, n: int):
             t0,
             tol=tol,
             max_iter=max_iter,
+            record_residuals=record_residuals,
         )
 
     _RUN_CACHE[key] = run
@@ -367,10 +371,10 @@ def _get_windowed_runner(
         t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
         return t_new / jnp.sum(t_new)
 
-    @partial(jax.jit, static_argnames=("max_iter", "tol"))
+    @partial(jax.jit, static_argnames=("max_iter", "tol", "record_residuals"))
     def run(
         wid, local, weight, seg_end, seg_first, seg_perm, dst_ptr,
-        t0, p, dangling, alpha, *, max_iter, tol,
+        t0, p, dangling, alpha, *, max_iter, tol, record_residuals=False,
     ):
         from ..ops.sparse import run_power_iteration
 
@@ -382,6 +386,7 @@ def _get_windowed_runner(
             t0,
             tol=tol,
             max_iter=max_iter,
+            record_residuals=record_residuals,
         )
 
     _RUN_CACHE[key] = run
@@ -404,14 +409,19 @@ def converge_sharded(
     alpha: float = 0.1,
     tol: float = 1e-6,
     max_iter: int = 50,
-) -> tuple[jax.Array, int, float]:
+    record_residuals: bool = False,
+) -> tuple:
     """Damped power iteration to an L1 fixed point on the mesh, with
     the kernel selected by the problem type (``SHARDED_KERNELS``):
     ``ShardedTrustProblem`` runs the CSR/cumsum SpMV,
     ``ShardedWindowPlan`` the fused windowed pipeline.
 
-    Returns ``(t, iterations, final residual)``.  ``tol <= 0`` runs
-    exactly ``max_iter`` fixed steps (benchmark mode).
+    Returns ``(t, iterations, final residual)`` — plus the device-side
+    per-iteration residual history as a fourth element when
+    ``record_residuals`` is set (the history rides the replicated
+    while-loop carry *outside* shard_map, so the per-shard step and its
+    single psum are untouched).  ``tol <= 0`` runs exactly ``max_iter``
+    fixed steps (benchmark mode).
 
     ``alpha`` is staged explicitly with the mesh-replicated sharding:
     a bare ``jnp.float32`` scalar (numpy's scalar type) would pay an
@@ -430,7 +440,7 @@ def converge_sharded(
             problem.table_entries,
             problem.interpret,
         )
-        t, it, resid = run(
+        out = run(
             problem.wid,
             problem.local,
             problem.weight,
@@ -444,20 +454,25 @@ def converge_sharded(
             alpha_dev,
             max_iter=max_iter,
             tol=tol,
+            record_residuals=record_residuals,
         )
-        return t, int(it), float(resid)
-    run = _get_runner(problem.mesh, problem.n)
-    t, it, resid = run(
-        problem.src,
-        problem.w,
-        problem.row_ptr,
-        problem.t0(),
-        problem.p,
-        problem.dangling,
-        alpha_dev,
-        max_iter=max_iter,
-        tol=tol,
-    )
+    else:
+        run = _get_runner(problem.mesh, problem.n)
+        out = run(
+            problem.src,
+            problem.w,
+            problem.row_ptr,
+            problem.t0(),
+            problem.p,
+            problem.dangling,
+            alpha_dev,
+            max_iter=max_iter,
+            tol=tol,
+            record_residuals=record_residuals,
+        )
+    t, it, resid = out[:3]
+    if record_residuals:
+        return t, int(it), float(resid), out[3]
     return t, int(it), float(resid)
 
 

@@ -46,23 +46,40 @@ from ..ops.gather_window import (
     converge_windowed,
     graph_fingerprint,
 )
+from ..obs import TRACER
+from ..obs.metrics import PLAN_REBUILDS, PLAN_REUSES
 from ..ops.sparse import converge_csr, converge_sparse
 from .graph import TrustGraph
 
 
 @dataclass
 class ConvergenceResult:
-    """Scores plus convergence metadata."""
+    """Scores plus convergence metadata — the aux bundle every backend
+    returns so the node can export convergence health without touching
+    device state again."""
 
     scores: np.ndarray  # (n,) float64, L1-normalized global trust
     iterations: int
     residual: float
     backend: str
+    #: Per-iteration L1 residual trajectory (length == ``iterations``),
+    #: captured device-side in the while-loop carry on the jax backends
+    #: and fetched once after convergence.  None when the caller opted
+    #: out (``record_residuals=False``).  The chunked ``tpu-dense``
+    #: backend records one residual per host-checked chunk instead (its
+    #: loop is host-driven between compiled scan chunks).
+    residuals: np.ndarray | None = None
 
     def scaled(self, total: float) -> np.ndarray:
         """Rescale to reference-style score units (e.g. N·INITIAL_SCORE
         so a uniform result reads 1000 per peer)."""
         return self.scores * total
+
+
+def _history(hist, iterations: int) -> np.ndarray:
+    """The one post-convergence fetch of the device-side residual
+    carry, sliced to the iterations actually run."""
+    return np.asarray(hist, dtype=np.float64)[: int(iterations)]
 
 
 class TrustBackend:
@@ -75,6 +92,7 @@ class TrustBackend:
         alpha: float = 0.0,
         tol: float = 1e-6,
         max_iter: int = 50,
+        record_residuals: bool = True,
     ) -> ConvergenceResult:
         raise NotImplementedError
 
@@ -91,7 +109,8 @@ class NativeCPUBackend(TrustBackend):
 
     name = "native-cpu"
 
-    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
+                 record_residuals=True):
         g = graph.drop_self_edges()
         dense = g.to_dense()
         n = g.n
@@ -118,12 +137,15 @@ class NativeCPUBackend(TrustBackend):
         t = list(p)
         it = 0
         resid = Fraction(0)
+        history: list[float] = []
         for it in range(1, max_iter + 1):
             new_t = [
                 (1 - a) * sum(rows[j][i] * t[j] for j in range(n)) + a * p[i]
                 for i in range(n)
             ]
             resid = sum(abs(x - y) for x, y in zip(new_t, t))
+            if record_residuals:
+                history.append(float(resid))
             t = new_t
             if tol > 0 and resid < tol:
                 break
@@ -132,13 +154,15 @@ class NativeCPUBackend(TrustBackend):
             iterations=it,
             residual=float(resid),
             backend=self.name,
+            residuals=np.array(history) if record_residuals else None,
         )
 
 
 class DenseJaxBackend(TrustBackend):
     name = "tpu-dense"
 
-    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
+                 record_residuals=True):
         g = graph.drop_self_edges()
         dense = g.to_dense().astype(np.float32)
         row_sums = dense.sum(axis=1)
@@ -150,14 +174,19 @@ class DenseJaxBackend(TrustBackend):
         m = jnp.asarray(m.astype(np.float32))
         it = 0
         resid = np.inf
+        history: list[float] = []
         # Fixed-size scan chunks with host-side residual checks between
-        # chunks: keeps the hot loop compiled while honoring tol.
+        # chunks: keeps the hot loop compiled while honoring tol.  The
+        # residual trajectory is therefore chunk-granular here (one
+        # entry per host check), unlike the while-loop backends.
         chunk = 8 if tol > 0 else max_iter
         while it < max_iter:
             steps = min(chunk, max_iter - it)
             t_new = converge_dense(m, t, steps)
             t_new = t_new / jnp.sum(t_new)
             resid = float(jnp.sum(jnp.abs(t_new - t)))
+            if record_residuals:
+                history.append(resid)
             t = t_new
             it += steps
             if tol > 0 and resid < tol:
@@ -167,34 +196,40 @@ class DenseJaxBackend(TrustBackend):
             iterations=it,
             residual=resid,
             backend=self.name,
+            residuals=np.array(history) if record_residuals else None,
         )
 
 
 class SparseJaxBackend(TrustBackend):
     name = "tpu-sparse"
 
-    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
+                 record_residuals=True):
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         g = TrustGraph(g.n, g.src, g.dst, w, graph.pre_trusted).sorted_by_dst()
         p = graph.pre_trust_vector()
-        t, it, resid = converge_sparse(
-            jnp.asarray(g.src),
-            jnp.asarray(g.dst),
-            jnp.asarray(g.weight),
-            jnp.asarray(p),
-            jnp.asarray(p),
-            jnp.asarray(dangling.astype(np.float32)),
-            n=g.n,
-            alpha=jax.device_put(np.float32(alpha)),
-            tol=tol,
-            max_iter=max_iter,
-        )
+        with TRACER.span("converge", backend=self.name):
+            out = converge_sparse(
+                jnp.asarray(g.src),
+                jnp.asarray(g.dst),
+                jnp.asarray(g.weight),
+                jnp.asarray(p),
+                jnp.asarray(p),
+                jnp.asarray(dangling.astype(np.float32)),
+                n=g.n,
+                alpha=jax.device_put(np.float32(alpha)),
+                tol=tol,
+                max_iter=max_iter,
+                record_residuals=record_residuals,
+            )
+        t, it, resid = out[:3]
         return ConvergenceResult(
             scores=np.asarray(t, dtype=np.float64),
             iterations=int(it),
             residual=float(resid),
             backend=self.name,
+            residuals=_history(out[3], it) if record_residuals else None,
         )
 
 
@@ -204,27 +239,32 @@ class CsrJaxBackend(TrustBackend):
 
     name = "tpu-csr"
 
-    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
+                 record_residuals=True):
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         g = TrustGraph(g.n, g.src, g.dst, w, graph.pre_trusted).sorted_by_dst()
         p = graph.pre_trust_vector()
-        t, it, resid = converge_csr(
-            jnp.asarray(g.src),
-            jnp.asarray(g.row_ptr_by_dst()),
-            jnp.asarray(g.weight),
-            jnp.asarray(p),
-            jnp.asarray(p),
-            jnp.asarray(dangling.astype(np.float32)),
-            alpha=jax.device_put(np.float32(alpha)),
-            tol=tol,
-            max_iter=max_iter,
-        )
+        with TRACER.span("converge", backend=self.name):
+            out = converge_csr(
+                jnp.asarray(g.src),
+                jnp.asarray(g.row_ptr_by_dst()),
+                jnp.asarray(g.weight),
+                jnp.asarray(p),
+                jnp.asarray(p),
+                jnp.asarray(dangling.astype(np.float32)),
+                alpha=jax.device_put(np.float32(alpha)),
+                tol=tol,
+                max_iter=max_iter,
+                record_residuals=record_residuals,
+            )
+        t, it, resid = out[:3]
         return ConvergenceResult(
             scores=np.asarray(t, dtype=np.float64),
             iterations=int(it),
             residual=float(resid),
             backend=self.name,
+            residuals=_history(out[3], it) if record_residuals else None,
         )
 
 
@@ -252,7 +292,8 @@ class WindowedJaxBackend(TrustBackend):
         #: The plan the last converge actually used (for persistence).
         self.last_plan: WindowPlan | None = plan
 
-    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
+                 record_residuals=True):
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         fp = graph_fingerprint(g.n, g.src, g.dst, w)
@@ -262,8 +303,17 @@ class WindowedJaxBackend(TrustBackend):
             or getattr(plan, "version", 0) != PLAN_VERSION
             or plan.fingerprint != fp
         ):
-            plan = build_window_plan(g.src, g.dst, w, n=g.n)
+            reason = "cold" if plan is None else (
+                "stale-layout"
+                if getattr(plan, "version", 0) != PLAN_VERSION
+                else "fingerprint-miss"
+            )
+            with TRACER.span("plan", backend=self.name, reason=reason):
+                plan = build_window_plan(g.src, g.dst, w, n=g.n)
+            PLAN_REBUILDS.inc()
             self.plan = plan
+        else:
+            PLAN_REUSES.inc()
         self.last_plan = plan
         p = graph.pre_trust_vector()
         interpret = (
@@ -271,23 +321,27 @@ class WindowedJaxBackend(TrustBackend):
             if self.interpret is not None
             else jax.default_backend() != "tpu"
         )
-        t, it, resid = converge_windowed(
-            *plan.device_args(),
-            jnp.asarray(p),
-            jnp.asarray(p),
-            jnp.asarray(dangling.astype(np.float32)),
-            n_rows=plan.n_rows,
-            table_entries=plan.table_entries,
-            alpha=jax.device_put(np.float32(alpha)),
-            tol=tol,
-            max_iter=max_iter,
-            interpret=interpret,
-        )
+        with TRACER.span("converge", backend=self.name):
+            out = converge_windowed(
+                *plan.device_args(),
+                jnp.asarray(p),
+                jnp.asarray(p),
+                jnp.asarray(dangling.astype(np.float32)),
+                n_rows=plan.n_rows,
+                table_entries=plan.table_entries,
+                alpha=jax.device_put(np.float32(alpha)),
+                tol=tol,
+                max_iter=max_iter,
+                interpret=interpret,
+                record_residuals=record_residuals,
+            )
+        t, it, resid = out[:3]
         return ConvergenceResult(
             scores=np.asarray(t, dtype=np.float64),
             iterations=int(it),
             residual=float(resid),
             backend=self.name,
+            residuals=_history(out[3], it) if record_residuals else None,
         )
 
 
@@ -316,7 +370,8 @@ class ShardedJaxBackend(TrustBackend):
         #: The plan the last converge actually used (for persistence).
         self.last_plan: WindowPlan | None = None
 
-    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50):
+    def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
+                 record_residuals=True):
         from ..parallel.mesh import default_mesh
         from ..parallel.sharded import (
             ShardedTrustProblem,
@@ -325,21 +380,31 @@ class ShardedJaxBackend(TrustBackend):
         )
 
         mesh = self.mesh if self.mesh is not None else default_mesh()
+        name = (
+            self.name if self.kernel == "tpu-csr" else f"{self.name}:{self.kernel}"
+        )
         problem: ShardedTrustProblem | ShardedWindowPlan
         if self.kernel == "tpu-windowed":
-            swp = ShardedWindowPlan.build(graph, mesh, plan=self.plan)
+            candidate = self.plan
+            with TRACER.span("plan", backend=name):
+                swp = ShardedWindowPlan.build(graph, mesh, plan=candidate)
+            (PLAN_REUSES if swp.plan is candidate else PLAN_REBUILDS).inc()
             self.plan = self.last_plan = swp.plan
             problem = swp
         else:
             problem = ShardedTrustProblem.build(graph, mesh)
-        t, it, resid = converge_sharded(
-            problem, alpha=alpha, tol=tol, max_iter=max_iter
-        )
+        with TRACER.span("converge", backend=name):
+            out = converge_sharded(
+                problem, alpha=alpha, tol=tol, max_iter=max_iter,
+                record_residuals=record_residuals,
+            )
+        t, it, resid = out[:3]
         return ConvergenceResult(
             scores=np.asarray(t, dtype=np.float64),
             iterations=it,
             residual=resid,
-            backend=self.name if self.kernel == "tpu-csr" else f"{self.name}:{self.kernel}",
+            backend=name,
+            residuals=_history(out[3], it) if record_residuals else None,
         )
 
 

@@ -60,6 +60,19 @@ class TestViolationFixtures:
         finding = errors[0]
         if fixture.marker is None:
             return
+        if fixture.kind == "ast":
+            # Pass-3 fixtures carry their violating code as a source
+            # string (so the repo-wide AST pass never sees it); the
+            # finding anchors inside that string at the marker line.
+            source, rel_path = fixture.build()
+            marker_line = next(
+                i
+                for i, line in enumerate(source.splitlines(), start=1)
+                if f"# VIOLATION: {fixture.marker}" in line
+            )
+            assert finding.file == rel_path
+            assert finding.line == marker_line
+            return
         assert finding.file is not None and finding.file.endswith("fixtures.py")
         assert finding.line == _marker_lines()[fixture.marker], (
             f"{name}: finding anchored at {finding.file}:{finding.line}, "
@@ -269,3 +282,103 @@ class TestAstRules:
             f for f in report["findings"] if f["pass"] == "ast" and f["severity"] == "error"
         ]
         assert ast_errors == []
+
+
+class TestObservabilityBoundaryRules:
+    """Pass 3: clocks/logging are host-boundary-only (ISSUE 4)."""
+
+    def test_clock_in_jit(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/x.py",
+            "import time\nimport jax\n"
+            "@jax.jit\ndef f(x):\n    t0 = time.perf_counter()\n    return x\n",
+        )
+        assert [f.rule for f in findings] == ["host-clock-in-jit"]
+        assert findings[0].line == 5
+
+    def test_bare_perf_counter_in_jit(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/x.py",
+            "from time import perf_counter\nimport jax\n"
+            "@jax.jit\ndef f(x):\n    return x, perf_counter()\n",
+        )
+        assert [f.rule for f in findings] == ["host-clock-in-jit"]
+
+    def test_span_in_jit(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/x.py",
+            "import jax\nfrom protocol_tpu.obs import TRACER\n"
+            "@jax.jit\ndef f(x):\n"
+            "    with TRACER.span('inner'):\n        return x * 2\n",
+        )
+        assert [f.rule for f in findings] == ["host-clock-in-jit"]
+
+    def test_logging_and_print_in_jit(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/x.py",
+            "import jax\nimport logging\nlog = logging.getLogger(__name__)\n"
+            "@jax.jit\ndef f(x):\n"
+            "    log.warning('x=%s', x)\n"
+            "    print(x)\n"
+            "    return x\n",
+        )
+        assert [f.rule for f in findings] == ["logging-in-jit"] * 2
+        assert [f.line for f in findings] == [6, 7]
+
+    def test_shard_map_body_is_traced(self, tmp_path):
+        """The sharded per-shard steps are shard_map-decorated, not
+        @jit-decorated — the rule must reach them too."""
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/parallel/x.py",
+            "from functools import partial\nimport jax\n"
+            "try:\n    _shard_map = jax.shard_map\n"
+            "except AttributeError:\n    _shard_map = None\n"
+            "def make(mesh):\n"
+            "    @partial(_shard_map, mesh=mesh)\n"
+            "    def step(t):\n"
+            "        import time\n"
+            "        return t, time.monotonic()\n"
+            "    return step\n",
+        )
+        rules = [f.rule for f in findings]
+        # the import inside parallel/ also trips the kernel-tree rule
+        assert "host-clock-in-jit" in rules
+
+    def test_nested_function_inherits_jit_context(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/x.py",
+            "import time\nimport jax\n"
+            "@jax.jit\ndef f(x):\n"
+            "    def inner(y):\n        return time.time(), y\n"
+            "    return inner(x)\n",
+        )
+        assert [f.rule for f in findings] == ["host-clock-in-jit"]
+
+    def test_clock_in_kernel_tree_outside_jit(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/ops/x.py",
+            "import time\ndef probe(x):\n    return time.monotonic(), x\n",
+        )
+        assert [f.rule for f in findings] == ["clock-in-kernel-tree"] * 2
+        assert [f.line for f in findings] == [1, 3]
+
+    def test_host_boundary_timing_in_node_tree_is_fine(self, tmp_path):
+        """node/ and trust/ wrap kernels in spans/timers at the host
+        boundary — legal; only traced bodies and kernel trees are
+        fenced."""
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/x.py",
+            "import time\nimport logging\nlog = logging.getLogger(__name__)\n"
+            "def tick():\n"
+            "    t0 = time.perf_counter()\n"
+            "    log.info('tick took %s', time.perf_counter() - t0)\n",
+        )
+        assert findings == []

@@ -86,6 +86,13 @@ class TestBulkIngest:
         bad_sum = make_attestation(2, scores=[1, 0, 0, 0, 0])
 
         m = Manager()
-        accepted = m.add_attestations_bulk([good, bad_sig, bad_sum])
-        assert accepted == [True, False, False]
+        results = m.add_attestations_bulk([good, bad_sig, bad_sum])
+        # IngestResult truthiness mirrors acceptance; rejections carry
+        # the structural/signature reason the metric is labelled with.
+        assert [bool(r) for r in results] == [True, False, False]
+        assert [r.reason for r in results] == [
+            None,
+            "bad-signature",
+            "non-conserving-scores",
+        ]
         assert len(m.attestations) == 1

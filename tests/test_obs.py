@@ -1,0 +1,575 @@
+"""Observability subsystem (ISSUE 4): trace spans, metrics registry,
+exporters, the /metrics and /trace/<epoch> endpoints, and the
+zero-hot-path-sync contracts (residual carry adds no gathers/callbacks;
+instrumented backends are bit-identical to uninstrumented ones under a
+transfer guard)."""
+
+import json
+import logging
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from protocol_tpu.models.graphs import erdos_renyi
+from protocol_tpu.node.epoch import Epoch
+from protocol_tpu.node.manager import Manager, ManagerConfig
+from protocol_tpu.node.server import handle_request
+from protocol_tpu.obs import METRICS, TRACER, metrics_json, prometheus_text
+from protocol_tpu.obs import metrics as obs_metrics
+from protocol_tpu.obs.metrics import MetricsRegistry
+from protocol_tpu.obs.trace import SpanContextFilter, Tracer, configure_logging
+from protocol_tpu.trust.backend import get_backend
+
+
+# ---------------------------------------------------------------------------
+# Trace spans
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_span_nesting_and_serialization(self):
+        tracer = Tracer()
+        with tracer.epoch(3):
+            with tracer.span("prove"):
+                with tracer.span("snark"):
+                    pass
+            with tracer.span("converge", backend="tpu-csr"):
+                pass
+        tree = tracer.get_trace(3)
+        assert tree["name"] == "epoch_tick"
+        assert tree["attrs"]["epoch"] == 3
+        assert [c["name"] for c in tree["children"]] == ["prove", "converge"]
+        (snark,) = tree["children"][0]["children"]
+        assert snark["name"] == "snark"
+        assert snark["duration_s"] >= 0
+        assert tree["children"][1]["attrs"]["backend"] == "tpu-csr"
+
+    def test_trace_survives_tick_exception(self):
+        tracer = Tracer()
+        with pytest.raises(RuntimeError):
+            with tracer.epoch(9):
+                with tracer.span("prove"):
+                    raise RuntimeError("boom")
+        tree = tracer.get_trace(9)
+        assert tree is not None and tree["attrs"]["error"] is True
+
+    def test_epoch_ring_evicts_oldest(self):
+        tracer = Tracer(keep_epochs=2)
+        for e in (1, 2, 3):
+            with tracer.epoch(e):
+                pass
+        assert tracer.epochs() == [2, 3]
+        assert tracer.get_trace(1) is None
+        assert tracer.latest_epoch() == 3
+
+    def test_threads_have_independent_span_stacks(self):
+        tracer = Tracer()
+        seen = {}
+
+        def tick(epoch):
+            with tracer.epoch(epoch):
+                with tracer.span(f"work_{epoch}"):
+                    pass
+            seen[epoch] = tracer.get_trace(epoch)
+
+        threads = [threading.Thread(target=tick, args=(e,)) for e in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for e in range(4):
+            assert [c["name"] for c in seen[e]["children"]] == [f"work_{e}"]
+
+    def test_span_close_hook_feeds_phase_histogram(self):
+        before = obs_metrics.PHASE_SECONDS.count(phase="unit_phase")
+        with TRACER.span("unit_phase"):
+            pass
+        assert obs_metrics.PHASE_SECONDS.count(phase="unit_phase") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", "help", labelnames=("reason",))
+        c.inc(reason="a")
+        c.inc(2, reason="a")
+        c.inc(reason="b")
+        assert c.value(reason="a") == 3 and c.value(reason="b") == 1
+        g = reg.gauge("g")
+        g.set(7.5)
+        assert g.value() == 7.5
+        h = reg.histogram("h", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        snap = h.snapshot()[()]
+        assert snap["count"] == 3 and snap["sum"] == 55.5
+        # cumulative buckets: le=1 -> 1, le=10 -> 2, le=+Inf -> 3
+        assert snap["buckets"] == [1, 2, 3]
+
+    def test_counters_are_monotonic(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_label_mismatch_rejected(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", labelnames=("reason",))
+        with pytest.raises(ValueError):
+            c.inc()
+        with pytest.raises(ValueError):
+            c.inc(reason="x", extra="y")
+
+    def test_registration_idempotent_but_kind_pinned(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("x_total")
+        assert reg.counter("x_total") is c1
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+
+    def test_thread_safety_under_concurrent_scrape(self):
+        """The ISSUE 4 concurrency contract: writer threads (epoch tick
+        / ingest) and scrape threads (HTTP GET /metrics) race on one
+        registry; totals stay exact and rendering never throws."""
+        reg = MetricsRegistry()
+        c = reg.counter("writes_total", labelnames=("worker",))
+        h = reg.histogram("vals", buckets=(0.25, 0.5, 0.75))
+        n_writers, per_writer = 8, 2000
+        errors = []
+        stop = threading.Event()
+
+        def writer(k):
+            try:
+                for i in range(per_writer):
+                    c.inc(worker=str(k))
+                    h.observe((i % 100) / 100.0)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def scraper():
+            try:
+                while not stop.is_set():
+                    from protocol_tpu.obs.export import prometheus_text
+
+                    text = prometheus_text(reg)
+                    assert "writes_total" in text
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        scrapers = [threading.Thread(target=scraper) for _ in range(2)]
+        writers = [threading.Thread(target=writer, args=(k,)) for k in range(n_writers)]
+        for t in scrapers + writers:
+            t.start()
+        for t in writers:
+            t.join()
+        stop.set()
+        for t in scrapers:
+            t.join()
+        assert errors == []
+        for k in range(n_writers):
+            assert c.value(worker=str(k)) == per_writer
+        assert h.count() == n_writers * per_writer
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition format
+# ---------------------------------------------------------------------------
+
+_LABEL_RE = r"[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""  # value may escape \" \\ \n
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"  # metric name
+    rf"(\{{{_LABEL_RE}(,{_LABEL_RE})*\}})?"
+    r" (-?[0-9.]+(e[+-]?[0-9]+)?|\+Inf|-Inf|NaN)$"
+)
+
+
+def _parse_prometheus(text: str) -> dict:
+    """Minimal exposition-format parser: every non-comment line must be
+    a well-formed sample; returns {sample_name_with_labels: value}."""
+    samples = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ", line), line
+            continue
+        assert _SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
+        name_labels, value = line.rsplit(" ", 1)
+        samples[name_labels] = float(value)
+    return samples
+
+
+class TestPrometheusExport:
+    def test_text_format_parses(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", "with help").inc(3)
+        reg.counter("b_total", "labelled", labelnames=("reason",)).inc(
+            reason='we"ird\nvalue'
+        )
+        reg.gauge("g", "a gauge").set(1.25)
+        reg.histogram("h", "a histogram", buckets=(0.1, 1.0)).observe(0.3)
+        from protocol_tpu.obs.export import prometheus_text
+
+        samples = _parse_prometheus(prometheus_text(reg))
+        assert samples["a_total"] == 3
+        assert samples["g"] == 1.25
+        assert samples['h_bucket{le="0.1"}'] == 0
+        assert samples['h_bucket{le="1"}'] == 1
+        assert samples['h_bucket{le="+Inf"}'] == 1
+        assert samples["h_count"] == 1
+
+    def test_histogram_buckets_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 3.0, 100.0):
+            h.observe(v)
+        from protocol_tpu.obs.export import prometheus_text
+
+        samples = _parse_prometheus(prometheus_text(reg))
+        counts = [
+            samples[f'h_bucket{{le="{b}"}}'] for b in ("1", "2", "4", "+Inf")
+        ]
+        assert counts == sorted(counts)  # monotone non-decreasing
+        assert counts[-1] == samples["h_count"] == 4
+
+    def test_global_registry_renders(self):
+        _parse_prometheus(prometheus_text())
+        assert "eigentrust_epochs_total" in metrics_json()
+
+
+# ---------------------------------------------------------------------------
+# Node endpoints
+# ---------------------------------------------------------------------------
+
+
+def _ticked_manager(backend="tpu-sparse"):
+    """A manager with one full epoch of work driven under the epoch
+    trace root, exactly as Node._epoch_tick does."""
+    m = Manager(ManagerConfig(prover="commitment", backend=backend))
+    m.generate_initial_attestations()
+    with TRACER.epoch(4):
+        with TRACER.span("prove"):
+            m.calculate_proofs(Epoch(4))
+        m.converge_epoch(Epoch(4), alpha=0.1)
+    return m
+
+
+class TestEndpoints:
+    def test_metrics_endpoint_prometheus_parses(self):
+        METRICS.reset()
+        m = _ticked_manager()
+        status, body = handle_request("GET", "/metrics", m)
+        assert status == 200
+        samples = _parse_prometheus(body)
+        assert samples["eigentrust_graph_peers"] == 5
+        assert samples["eigentrust_convergence_iterations"] >= 1
+
+    def test_residual_histogram_length_equals_iterations(self):
+        METRICS.reset()
+        m = _ticked_manager()
+        result = m.cached_results[Epoch(4)]
+        status, body = handle_request("GET", "/metrics", m)
+        samples = _parse_prometheus(body)
+        assert samples["eigentrust_convergence_residual_count"] == result.iterations
+        assert len(result.residuals) == result.iterations
+
+    def test_trace_endpoint_span_tree_nesting(self):
+        m = _ticked_manager()
+        status, body = handle_request("GET", "/trace/4", m)
+        assert status == 200
+        tree = json.loads(body)
+        assert tree["name"] == "epoch_tick"
+        names = [c["name"] for c in tree["children"]]
+        assert names[0] == "prove"
+        assert "build_graph" in names and "converge" in names
+        prove_children = [c["name"] for c in tree["children"][0]["children"]]
+        assert prove_children == ["power_iterate", "circuit_check", "snark"]
+
+    def test_trace_latest_and_errors(self):
+        m = _ticked_manager()
+        status, body = handle_request("GET", "/trace/latest", m)
+        assert status == 200 and json.loads(body)["name"] == "epoch_tick"
+        status, _ = handle_request("GET", "/trace/notanint", m)
+        assert status == 400
+        status, body = handle_request("GET", "/trace/123456789", m)
+        assert status == 404 and "no trace" in json.loads(body)["error"]
+
+    def test_status_lists_traced_epochs(self):
+        m = _ticked_manager()
+        status, body = handle_request("GET", "/status", m)
+        assert 4 in json.loads(body)["traced_epochs"]
+
+    def test_metrics_content_type_over_socket(self):
+        """Socket-level: /metrics must be served text/plain (Prometheus
+        scrapers reject JSON content types)."""
+        import asyncio
+
+        from protocol_tpu.node.config import ProtocolConfig
+        from protocol_tpu.node.server import Node
+
+        async def scenario():
+            cfg = ProtocolConfig(
+                epoch_interval=3600, endpoint=((127, 0, 0, 1), 0),
+                prover="commitment",
+            )
+            node = Node.from_config(cfg)
+            await node.start()
+            port = node._server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nhost: x\r\n\r\n")
+            await writer.drain()
+            response = (await reader.read()).decode()
+            writer.close()
+            await node.stop()
+            return response
+
+        response = asyncio.run(scenario())
+        head, _, body = response.partition("\r\n\r\n")
+        assert "200 OK" in head
+        assert "content-type: text/plain; version=0.0.4" in head
+        _parse_prometheus(body)
+
+    def test_bulk_ingest_rejection_reasons_counted(self):
+        from protocol_tpu.crypto.eddsa import SecretKey, sign
+        from tests.test_node import make_attestation
+
+        METRICS.reset()
+        good = make_attestation(0)
+        bad_sig = make_attestation(1)
+        bad_sig.sig = sign(SecretKey.random(), SecretKey.random().public(), 1)
+        bad_sum = make_attestation(2, scores=[1, 0, 0, 0, 0])
+        m = Manager()
+        results = m.add_attestations_bulk([good, bad_sig, bad_sum])
+        assert [r.accepted for r in results] == [True, False, False]
+        assert obs_metrics.ATTESTATIONS_ACCEPTED.value() == 1
+        assert obs_metrics.ATTESTATIONS_REJECTED.value(reason="bad-signature") == 1
+        assert (
+            obs_metrics.ATTESTATIONS_REJECTED.value(reason="non-conserving-scores")
+            == 1
+        )
+        _, body = handle_request("GET", "/metrics", m)
+        assert (
+            'eigentrust_attestations_rejected_total{reason="bad-signature"} 1'
+            in body
+        )
+
+    def test_checkpoint_counters(self, tmp_path):
+        from protocol_tpu.node.checkpoint import CheckpointStore
+
+        METRICS.reset()
+        store = CheckpointStore(tmp_path)
+        g = erdos_renyi(30, seed=2)
+        store.save(Epoch(1), g)
+        store.load_latest()
+        assert obs_metrics.CHECKPOINT_SAVES.value() == 1
+        assert obs_metrics.CHECKPOINT_RESTORES.value() == 1
+
+
+# ---------------------------------------------------------------------------
+# Hot-path contracts
+# ---------------------------------------------------------------------------
+
+
+class TestHotPathContracts:
+    """The residual carry must not change the kernel access pattern,
+    and instrumented convergence must be bit-identical."""
+
+    BACKENDS = ("tpu-sparse", "tpu-csr", "tpu-windowed", "tpu-sharded")
+
+    @pytest.mark.parametrize("name", BACKENDS)
+    def test_instrumented_bit_identical_under_transfer_guard(self, name):
+        g = erdos_renyi(150, avg_degree=5.0, seed=3)
+        backend_on = get_backend(name)
+        backend_off = get_backend(name)
+        with jax.transfer_guard("disallow"):
+            on = backend_on.converge(g, alpha=0.1, tol=1e-9, max_iter=30)
+            off = backend_off.converge(
+                g, alpha=0.1, tol=1e-9, max_iter=30, record_residuals=False
+            )
+        assert np.array_equal(on.scores, off.scores)  # bit-identical
+        assert off.residuals is None
+        assert len(on.residuals) == on.iterations == off.iterations
+        # The trajectory's last entry is the reported final residual.
+        np.testing.assert_allclose(on.residuals[-1], on.residual, rtol=1e-6)
+
+    def test_residual_carry_adds_no_gathers_or_callbacks(self):
+        """ISSUE 4 satellite: KERNEL_INVARIANTS budgets are unchanged
+        with the residual-carry step — the full converge jaxpr has the
+        same gather count, zero scatters, and zero callbacks with the
+        history carry enabled."""
+        import jax.numpy as jnp
+
+        from protocol_tpu.analysis.jaxpr_walk import (
+            CALLBACK_PRIMITIVES,
+            SCATTER_PRIMITIVES,
+            collect_primitives,
+        )
+        from protocol_tpu.ops.sparse import converge_csr
+        from protocol_tpu.trust.graph import TrustGraph
+
+        g = erdos_renyi(100, avg_degree=4.0, seed=5).drop_self_edges()
+        w, dangling = g.row_normalized()
+        gs = TrustGraph(g.n, g.src, g.dst, w, g.pre_trusted).sorted_by_dst()
+        p = g.pre_trust_vector()
+        args = (
+            jnp.asarray(gs.src),
+            jnp.asarray(gs.row_ptr_by_dst()),
+            jnp.asarray(gs.weight),
+            jnp.asarray(p),
+            jnp.asarray(p),
+            jnp.asarray(dangling.astype(np.float32)),
+        )
+
+        def counts(record):
+            jaxpr = jax.make_jaxpr(
+                lambda *a: converge_csr(
+                    a[0], a[1], a[2], a[3], a[4], a[5],
+                    alpha=0.1, tol=1e-6, max_iter=8,
+                    record_residuals=record,
+                )
+            )(*args)
+            return (
+                len(collect_primitives(jaxpr, {"gather"})),
+                len(collect_primitives(jaxpr, SCATTER_PRIMITIVES)),
+                len(collect_primitives(jaxpr, CALLBACK_PRIMITIVES)),
+            )
+
+        gathers_off, scatters_off, callbacks_off = counts(False)
+        gathers_on, scatters_on, callbacks_on = counts(True)
+        assert gathers_on == gathers_off
+        assert scatters_on == scatters_off == 0
+        assert callbacks_on == callbacks_off == 0
+
+    def test_trace_store_read_does_not_touch_device(self):
+        """Serving /trace is a host-side dict copy: no jax arrays are
+        reachable from the serialized tree."""
+        m = _ticked_manager()
+        tree = TRACER.get_trace(4)
+
+        def walk(node):
+            assert isinstance(node["name"], str)
+            for k, v in node.get("attrs", {}).items():
+                assert isinstance(v, (str, int, float, bool, type(None))), (k, v)
+            for child in node["children"]:
+                walk(child)
+
+        walk(tree)
+
+
+# ---------------------------------------------------------------------------
+# Logging integration
+# ---------------------------------------------------------------------------
+
+
+class TestConfigureLogging:
+    def _fresh_root(self):
+        root = logging.getLogger()
+        saved = (root.handlers[:], root.level)
+        root.handlers[:] = []
+        return root, saved
+
+    def _restore(self, root, saved):
+        root.handlers[:] = saved[0]
+        root.setLevel(saved[1])
+
+    def test_installs_handler_on_pristine_root(self):
+        root, saved = self._fresh_root()
+        try:
+            configure_logging()
+            assert len(root.handlers) == 1
+            handler = root.handlers[0]
+            assert any(isinstance(f, SpanContextFilter) for f in handler.filters)
+            # The format resolves: a record through the handler must not
+            # raise on the %(epoch)s / %(span)s columns.
+            record = logging.LogRecord(
+                "x", logging.INFO, __file__, 1, "hello", (), None
+            )
+            for f in handler.filters:
+                f.filter(record)
+            assert "epoch=-" in handler.format(record)
+        finally:
+            self._restore(root, saved)
+
+    def test_respects_existing_root_handler(self):
+        root, saved = self._fresh_root()
+        try:
+            mine = logging.StreamHandler()
+            fmt = logging.Formatter("%(message)s")
+            mine.setFormatter(fmt)
+            root.addHandler(mine)
+            configure_logging()
+            configure_logging()  # idempotent
+            assert root.handlers == [mine]  # no second handler
+            assert mine.formatter is fmt  # formatter untouched
+            # ...but the span filter was attached exactly once.
+            filters = [f for f in mine.filters if isinstance(f, SpanContextFilter)]
+            assert len(filters) == 1
+        finally:
+            self._restore(root, saved)
+
+    def test_records_carry_span_context(self):
+        record_holder = {}
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                record_holder["r"] = record
+
+        logger = logging.getLogger("protocol_tpu.test_obs")
+        handler = Capture()
+        handler.addFilter(SpanContextFilter())
+        logger.addHandler(handler)
+        try:
+            with TRACER.epoch(11):
+                with TRACER.span("prove"):
+                    logger.warning("inside")
+            r = record_holder["r"]
+            assert r.epoch == 11 and r.span == "prove" and r.span_id > 0
+        finally:
+            logger.removeHandler(handler)
+
+
+# ---------------------------------------------------------------------------
+# Bench parity
+# ---------------------------------------------------------------------------
+
+
+class TestBenchPhases:
+    def test_headline_embeds_span_phase_timings(self):
+        """BENCH_*.json parity (ISSUE 4 CI satellite): the headline
+        entry embeds span-derived phase timings under the same names
+        the node's /trace reports."""
+        import bench
+
+        entry = bench.headline_entry(
+            iters=2, backend="tpu-csr", n_peers=1024, n_edges=4096
+        )
+        assert entry["phases"].keys() == {"converge"}
+        assert entry["phases"]["converge"] >= 0
+        windowed = bench.headline_entry(
+            iters=2, backend="tpu-windowed", n_peers=2048, n_edges=8192
+        )
+        assert set(windowed["phases"]) == {"plan", "converge"}
+
+
+class TestProfileSession:
+    def test_noop_without_dir(self):
+        from protocol_tpu.obs import profile_session
+
+        with profile_session(None):
+            pass
+
+    def test_writes_profile_artifacts(self, tmp_path):
+        from protocol_tpu.obs import profile_session
+
+        import jax.numpy as jnp
+
+        with profile_session(str(tmp_path / "prof")):
+            jnp.asarray(np.ones(8, np.float32)).sum().block_until_ready()
+        assert any((tmp_path / "prof").rglob("*")), "no profiler output written"
