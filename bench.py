@@ -187,6 +187,140 @@ def headline_entry(
     }
 
 
+def epochs_entry(
+    epochs: int = 5,
+    churn: float = 0.01,
+    backend: str = "tpu-windowed",
+    n_peers: int = 1_000_000,
+    n_edges: int = 50_000_000,
+    tol: float = 1e-6,
+    max_iter: int = 60,
+    seed: int = 7,
+) -> dict:
+    """Multi-epoch steady-state benchmark (PERF.md §11, ISSUE 5).
+
+    Epoch 0 runs the cold path: full ``WindowPlan`` build plus a
+    cold-start convergence from the pre-trust vector.  Every later
+    epoch replays ``churn``·E edges of *sender-centric* churn — a
+    recency-biased cohort of peers re-attests, each rewriting its whole
+    out-row (row normalization makes the row the atomic delta unit) —
+    and runs the steady-state path: plan delta
+    (``WindowPlan.apply_delta`` via the backend's ``delta_rows`` hint)
+    + warm-start convergence from the previous fixed point.  The
+    recency bias mirrors production id assignment: manager peer ids are
+    first-seen order, so the churning cohort (recently joined / most
+    active users) is id-local, keeping the delta's touched windows far
+    below the window count (the delta/rebuild crossover, PERF.md §11).
+
+    The cold number excludes compile (one discarded warm-up converge,
+    same policy as the headline); the per-epoch numbers are pure
+    plan-update + converge wall-clock.  Correctness is pinned by
+    cold-converging the FINAL churned graph on a fresh backend and
+    requiring the warm scores to match within the convergence
+    tolerance.
+    """
+    import numpy as np
+
+    from protocol_tpu.models.graphs import scale_free
+    from protocol_tpu.obs.metrics import PLAN_OUTCOMES
+    from protocol_tpu.trust.backend import get_backend
+    from protocol_tpu.trust.graph import TrustGraph
+
+    rng = np.random.default_rng(seed)
+    graph = scale_free(n_peers, n_edges, seed=seed).drop_self_edges()
+    b = get_backend(backend)
+
+    # Pre-build the plan so epoch 0 separates plan cost from converge
+    # cost, and a throwaway converge eats the jit compile.
+    plan_seconds = 0.0
+    if hasattr(b, "plan"):
+        from protocol_tpu.ops.gather_window import build_window_plan
+
+        w, _ = graph.row_normalized()
+        plan, plan_seconds = _timed(
+            lambda: build_window_plan(graph.src, graph.dst, w, n=graph.n)
+        )
+        b.plan = plan
+    b.converge(graph, alpha=0.1, tol=tol, max_iter=max_iter)  # compile
+    res0, cold_converge = _timed(
+        lambda: b.converge(graph, alpha=0.1, tol=tol, max_iter=max_iter)
+    )
+    cold_epoch_seconds = plan_seconds + cold_converge
+
+    per_epoch = []
+    scores = res0.scores
+    cur = graph
+    delta0 = PLAN_OUTCOMES.value(outcome="delta")
+    rebuild0 = PLAN_OUTCOMES.value(outcome="rebuild")
+    avg_deg = max(cur.nnz / n_peers, 1.0)
+    cohort_size = max(1, int(round(churn * cur.nnz / avg_deg)))
+    deg = max(1, int(round(avg_deg)))
+    for k in range(1, epochs):
+        # Recency-biased re-attesting cohort: ids exponential toward
+        # the top of the id space (first-seen order ⇒ newest peers).
+        offs = rng.exponential(
+            scale=max(n_peers * 0.02, cohort_size), size=cohort_size
+        ).astype(np.int64)
+        rows = np.unique(n_peers - 1 - np.minimum(offs, n_peers - 1))
+        keep = ~np.isin(cur.src, rows.astype(np.int32))
+        ns = np.repeat(rows.astype(np.int32), deg)
+        nd = rng.integers(0, n_peers, ns.shape[0]).astype(np.int32)
+        while (bad := nd == ns).any():  # no self-edges
+            nd[bad] = rng.integers(0, n_peers, int(bad.sum()))
+        nw = rng.integers(1, 1000, ns.shape[0]).astype(np.float32)
+        cur = TrustGraph(
+            cur.n,
+            np.concatenate([cur.src[keep], ns]),
+            np.concatenate([cur.dst[keep], nd]),
+            np.concatenate([cur.weight[keep], nw]),
+            cur.pre_trusted,
+        )
+        if hasattr(b, "delta_rows"):
+            b.delta_rows = rows
+        res, dt = _timed(
+            lambda: b.converge(cur, alpha=0.1, tol=tol, max_iter=max_iter, t0=scores)
+        )
+        scores = res.scores
+        per_epoch.append(
+            {"epoch": k, "seconds": round(dt, 4), "iterations": res.iterations}
+        )
+
+    # Correctness pin: a fresh backend cold-converges the final graph.
+    ref = get_backend(backend).converge(cur, alpha=0.1, tol=tol, max_iter=max_iter)
+    warm_vs_cold_l1 = float(np.abs(scores - ref.scores).sum())
+
+    steady = sorted(e["seconds"] for e in per_epoch)
+    steady_state_epoch_seconds = steady[len(steady) // 2] if steady else 0.0
+    warm_iters = [e["iterations"] for e in per_epoch]
+    return {
+        "metric": (
+            f"steady-state epoch wall-clock (plan update + converge) at "
+            f"{churn:.2%} churn/epoch, {n_peers} peers / {n_edges} edges, {backend}"
+        ),
+        "value": round(steady_state_epoch_seconds, 4),
+        "unit": "seconds",
+        "epochs": epochs,
+        "churn": churn,
+        "cold_epoch_seconds": round(cold_epoch_seconds, 4),
+        "steady_state_epoch_seconds": round(steady_state_epoch_seconds, 4),
+        "cold_vs_steady_speedup": round(
+            cold_epoch_seconds / max(steady_state_epoch_seconds, 1e-9), 2
+        ),
+        "plan_seconds": round(plan_seconds, 4),
+        "cold_iterations": int(ref.iterations),
+        "warm_iterations_mean": round(sum(warm_iters) / max(len(warm_iters), 1), 2),
+        "iterations_saved_by_warm_start": round(
+            ref.iterations - sum(warm_iters) / max(len(warm_iters), 1), 2
+        ),
+        "plan_outcomes": {
+            "delta": PLAN_OUTCOMES.value(outcome="delta") - delta0,
+            "rebuild": PLAN_OUTCOMES.value(outcome="rebuild") - rebuild0,
+        },
+        "warm_vs_cold_l1": warm_vs_cold_l1,
+        "per_epoch": per_epoch,
+    }
+
+
 def ladder(scale_div: int = 1, iters: int = 40, backend: str = "tpu-windowed") -> list[dict]:
     """The five BASELINE.md configs.
 
@@ -362,12 +496,48 @@ def main() -> None:
         "var alone is not enough — this applies the config override the "
         "way tests/conftest.py does",
     )
+    ap.add_argument(
+        "--epochs",
+        type=int,
+        default=None,
+        help="multi-epoch steady-state benchmark: epoch 0 cold (full "
+        "plan build + cold converge), then N-1 churned epochs on the "
+        "steady-state path (plan delta + warm start); prints one JSON "
+        "line with steady_state_epoch_seconds and "
+        "iterations_saved_by_warm_start next to the cold number",
+    )
+    ap.add_argument(
+        "--churn",
+        type=float,
+        default=0.01,
+        help="edge fraction rewired per steady-state epoch (with --epochs)",
+    )
+    ap.add_argument(
+        "--peers", type=int, default=1_000_000, help="graph size for --epochs"
+    )
+    ap.add_argument(
+        "--edges", type=int, default=50_000_000, help="edge count for --epochs"
+    )
     args = ap.parse_args()
 
     if args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.epochs is not None:
+        print(
+            json.dumps(
+                epochs_entry(
+                    epochs=args.epochs,
+                    churn=args.churn,
+                    backend=args.backend,
+                    n_peers=args.peers,
+                    n_edges=args.edges,
+                )
+            )
+        )
+        return
 
     if args.ladder:
         entries = ladder(scale_div=args.scale_div, backend=args.backend)

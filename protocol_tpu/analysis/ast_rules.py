@@ -39,6 +39,17 @@ Pass 3 — the observability-boundary rules (the obs subsystem's
   from the outside (``trust/backend.py``, ``node/``); the kernel
   modules themselves stay clock- and logger-free so no refactor can
   quietly move a host boundary inside one.
+
+Pass 4 — the epoch-pipeline boundary rule (ISSUE 5):
+
+- ``plan-mutation-in-converge`` (error): a ``WindowPlan`` mutation
+  entry point (``apply_delta``/``replace_rows``) called inside a
+  traced function.  Delta application is host-side layout surgery
+  (numpy repacks, counting sorts) and must run strictly pre-dispatch
+  — in ``Manager.prepare_epoch`` or the backend's plan-resolution
+  step — never from the device-facing converge path, where it would
+  trace host arrays into the kernel (or silently run once at trace
+  time and serve a stale layout forever after).
 """
 
 from __future__ import annotations
@@ -175,6 +186,18 @@ def _is_logging_call(name: str | None) -> bool:
     return leaf in _LOGGING_METHODS and receiver in ("log", "logger")
 
 
+#: WindowPlan mutation entry points — host-side layout surgery that
+#: must never run under a trace (pass 4).
+_PLAN_MUTATION_METHODS = frozenset({"apply_delta", "replace_rows"})
+
+
+def _is_plan_mutation_call(name: str | None) -> bool:
+    """``<anything>.apply_delta(...)`` / ``<anything>.replace_rows(...)``
+    — the delta surface is small and uniquely named, so matching the
+    method leaf is precise enough for a lint."""
+    return name is not None and name.rsplit(".", 1)[-1] in _PLAN_MUTATION_METHODS
+
+
 def _is_span_call(name: str | None) -> bool:
     """obs span entry points (``TRACER.span``/``TRACER.epoch`` or any
     ``*.span(...)``) — host boundaries by definition, so inside a
@@ -278,6 +301,16 @@ class _Visitor(ast.NodeVisitor):
                     f"{name}() inside a traced function executes once "
                     "at trace time, not per call — log at the host "
                     "boundary instead",
+                    node,
+                )
+            elif _is_plan_mutation_call(name):
+                self._emit(
+                    "plan-mutation-in-converge",
+                    f"{name}() inside a traced function: WindowPlan "
+                    "delta application is host-side layout surgery and "
+                    "must run pre-dispatch (Manager.prepare_epoch / the "
+                    "backend's plan resolution), never from the "
+                    "device-facing converge path",
                     node,
                 )
         elif self.kernel_tree and (

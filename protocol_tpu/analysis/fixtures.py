@@ -209,6 +209,27 @@ def _clock_in_kernel_tree() -> tuple[str, str]:
     return _CLOCK_IN_KERNEL_SRC, "protocol_tpu/ops/_fixture_clock_in_kernel.py"
 
 
+_PLAN_MUTATION_SRC = '''\
+import jax
+
+
+def make_step(plan, fingerprint):
+    @jax.jit
+    def step(t, inserts, deletes):
+        # Delta application belongs in the host stage, pre-dispatch;
+        # under a trace it runs once at trace time and the kernel then
+        # serves a stale layout forever after.
+        new_plan = plan.apply_delta(inserts, deletes, fingerprint=fingerprint)  # VIOLATION: plan-mutation-in-converge
+        return t * 2.0, new_plan
+
+    return step
+'''
+
+
+def _plan_mutation_in_converge() -> tuple[str, str]:
+    return _PLAN_MUTATION_SRC, "protocol_tpu/trust/_fixture_plan_mutation.py"
+
+
 FIXTURES: dict[str, Fixture] = {
     f.name: f
     for f in (
@@ -240,6 +261,11 @@ FIXTURES: dict[str, Fixture] = {
         Fixture(
             "clock-in-kernel-tree", "clock-in-kernel-tree",
             _clock_in_kernel_tree, "clock-in-kernel-tree", kind="ast",
+        ),
+        Fixture(
+            "plan-mutation-in-converge", "plan-mutation-in-converge",
+            _plan_mutation_in_converge, "plan-mutation-in-converge",
+            kind="ast",
         ),
     )
 }

@@ -169,8 +169,9 @@ def _trace_windowed(graph) -> TraceCase:
     g, gs, w, dangling = _normalized(graph)
     plan = build_window_plan(g.src, g.dst, w, n=g.n)
     # Keep the budget dimensions distinguishable: the rowsum gathers are
-    # (n+1)-sized, the bridge reads n_segments-sized.
-    assert plan.n_segments != g.n + 1, "synthetic graph aliases budget dims"
+    # (n+1)-sized, the bridge reads seg_capacity-sized (the device
+    # length of the padded segment tables, >= n_segments live runs).
+    assert plan.seg_capacity != g.n + 1, "synthetic graph aliases budget dims"
     p = g.pre_trust_vector()
     args = plan.device_args() + (
         jnp.asarray(p),
@@ -198,7 +199,7 @@ def _trace_windowed(graph) -> TraceCase:
     return TraceCase(
         "tpu-windowed",
         jaxpr,
-        dims={"n_segments": plan.n_segments, "n": g.n},
+        dims={"n_segments": plan.seg_capacity, "n": g.n},
         lowered_text=lowered,
     )
 

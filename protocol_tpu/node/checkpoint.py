@@ -9,13 +9,19 @@ score vector and can serve scores immediately after restart while the
 replay catches up.
 
 Format: ``<dir>/epoch_<N>.npz`` (numpy arrays) + ``manifest.json``
-pointing at the latest; writes are atomic (tmp + rename).  When the
-node converges on a windowed backend (``tpu-windowed`` or
+pointing at the latest; writes are atomic (tmp + rename).  The
+snapshot optionally carries a ``peer_hashes`` column (Poseidon hash
+per score row, graph assembly order) — the key the warm-start remap
+needs, so a reboot's first epoch converges from the checkpointed
+fixed point instead of cold (PERF.md §11).  When the node converges
+on a windowed backend (``tpu-windowed`` or
 ``tpu-sharded:tpu-windowed``), the one-time bucketing plan
 (ops.gather_window.WindowPlan — the expensive host-side layout) rides
-along as ``epoch_<N>.plan.npz`` so a reboot revalidates it by
-fingerprint + layout version instead of rebuilding it; a sidecar from
-a stale plan-format version is ignored (rebuild on first converge).
+along as ``epoch_<N>.plan.npz``, including its delta lineage (the
+ancestor-fingerprint chain of ``apply_delta`` updates), so a reboot
+revalidates it by fingerprint + layout version instead of rebuilding
+it; a sidecar from a stale plan-format version is ignored (rebuild on
+first converge).
 """
 
 from __future__ import annotations
@@ -42,6 +48,10 @@ class Snapshot:
     scores: np.ndarray | None
     proof_json: str | None = None
     plan: WindowPlan | None = None
+    #: Peer hash per score row (graph assembly order) — the key the
+    #: warm-start remap needs, so a reboot's first epoch starts from
+    #: the checkpointed fixed point instead of cold.
+    peer_hashes: list[int] | None = None
 
 
 class CheckpointStore:
@@ -72,6 +82,7 @@ class CheckpointStore:
         scores=None,
         proof_json: str | None = None,
         plan: WindowPlan | None = None,
+        peer_hashes: list[int] | None = None,
     ) -> Path:
         CHECKPOINT_SAVES.inc()
         path = self._path(epoch)
@@ -85,6 +96,12 @@ class CheckpointStore:
             payload["pre_trusted"] = graph.pre_trusted
         if scores is not None:
             payload["scores"] = np.asarray(scores, dtype=np.float64)
+        if peer_hashes is not None:
+            # Poseidon hashes are field elements < 2^254: 32 bytes each,
+            # big-endian, one fixed-width bytes row per score row.
+            payload["peer_hashes"] = np.array(
+                [h.to_bytes(32, "big") for h in peer_hashes], dtype="S32"
+            )
 
         self._atomic_write(path, lambda f: np.savez_compressed(f, **payload), "wb")
         if plan is not None:
@@ -136,6 +153,11 @@ class CheckpointStore:
                     pre_trusted=z["pre_trusted"] if "pre_trusted" in z else None,
                 )
                 scores = np.array(z["scores"]) if "scores" in z else None
+                peer_hashes = (
+                    [int.from_bytes(bytes(b), "big") for b in z["peer_hashes"]]
+                    if "peer_hashes" in z
+                    else None
+                )
             proof_path = self.dir / f"epoch_{epoch.number}.proof.json"
             proof_json = proof_path.read_text() if proof_path.exists() else None
             plan_path = self.dir / f"epoch_{epoch.number}.plan.npz"
@@ -152,7 +174,12 @@ class CheckpointStore:
                         plan = None
         CHECKPOINT_RESTORES.inc()
         return Snapshot(
-            epoch=epoch, graph=graph, scores=scores, proof_json=proof_json, plan=plan
+            epoch=epoch,
+            graph=graph,
+            scores=scores,
+            proof_json=proof_json,
+            plan=plan,
+            peer_hashes=peer_hashes,
         )
 
     def load_latest(self) -> Snapshot | None:

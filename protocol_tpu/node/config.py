@@ -27,6 +27,18 @@ class ProtocolConfig:
     trust_backend: str = "native-cpu"
     event_fixture: str | None = None
     checkpoint_dir: str | None = None
+    #: Double-buffered epoch pipeline (node/pipeline.py): overlap the
+    #: next epoch's host stages (ingest drain, graph build, plan delta)
+    #: with the current epoch's device converge + proving, behind a
+    #: bounded queue with coalescing backpressure.  Off by default —
+    #: the sequential tick is easier to reason about on small nodes.
+    epoch_pipeline: bool = False
+    #: Seed each epoch's convergence from the previous fixed point
+    #: (ManagerConfig.warm_start).
+    warm_start: bool = True
+    #: Dirty-row fraction above which the windowed plan cache rebuilds
+    #: instead of delta-updating (ManagerConfig.plan_delta_max_churn).
+    plan_delta_max_churn: float = 0.05
     #: "plonk" (real KZG SNARK per epoch, the reference's behavior) or
     #: "commitment" (fast Poseidon binding).
     prover: str = "plonk"
@@ -60,6 +72,11 @@ class ProtocolConfig:
         cfg.trust_backend = obj.get("trust_backend", cfg.trust_backend)
         cfg.event_fixture = obj.get("event_fixture", cfg.event_fixture)
         cfg.checkpoint_dir = obj.get("checkpoint_dir", cfg.checkpoint_dir)
+        cfg.epoch_pipeline = bool(obj.get("epoch_pipeline", cfg.epoch_pipeline))
+        cfg.warm_start = bool(obj.get("warm_start", cfg.warm_start))
+        cfg.plan_delta_max_churn = float(
+            obj.get("plan_delta_max_churn", cfg.plan_delta_max_churn)
+        )
         cfg.prover = obj.get("prover", cfg.prover)
         cfg.srs_path = obj.get("srs_path", cfg.srs_path)
         cfg.profile_dir = obj.get("profile_dir", cfg.profile_dir)

@@ -15,6 +15,7 @@ Rebuild of server/src/manager/mod.rs:72-237.  Differences by design:
 from __future__ import annotations
 
 import logging
+from contextlib import contextmanager
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
@@ -65,6 +66,16 @@ class ManagerConfig:
     #: it the PLONK prover generates a fresh random setup at boot —
     #: sound only for verifiers who trust this node's keygen.
     srs_path: str | None = None
+    #: Seed each epoch's convergence from the previous epoch's fixed
+    #: point (renormalized over joined/departed peers) — the fixed
+    #: point is start-independent, so this only shortens the path
+    #: (sparse power methods converge dramatically faster from a
+    #: near-fixed-point start; PERF.md §11).
+    warm_start: bool = True
+    #: Dirty-row fraction above which the windowed plan cache skips the
+    #: delta update and rebuilds from scratch: past this crossover the
+    #: per-window repack costs more than the full counting sorts.
+    plan_delta_max_churn: float = 0.05
 
 
 @dataclass(frozen=True)
@@ -81,6 +92,30 @@ class IngestResult:
 
     def __bool__(self) -> bool:
         return self.accepted
+
+
+@dataclass
+class PreparedEpoch:
+    """Output of the host stage of one epoch (``Manager.prepare_epoch``):
+    everything ``converge_prepared`` needs to dispatch device work, and
+    nothing that touches the attestation cache again — so the pipeline
+    can prepare epoch k+1 while epoch k still owns the device."""
+
+    epoch: Epoch
+    graph: TrustGraph
+    #: Peer hash per graph row id, assembly order (the score index map).
+    id_order: list[int]
+    #: Warm-start seed remapped onto this graph's id space, or None for
+    #: a cold start.
+    t0: np.ndarray | None
+    #: Churn hint for the windowed plan cache (row ids whose out-edges
+    #: changed since the cached plan), or None to force plan
+    #: revalidation by fingerprint alone.
+    delta_rows: np.ndarray | None
+    #: The dirty-sender snapshot this graph absorbed — subtracted from
+    #: the manager's dirty set only after a successful converge, so a
+    #: failed epoch leaves the churn accounting intact.
+    dirty_snapshot: set[int]
 
 
 class Manager:
@@ -110,6 +145,17 @@ class Manager:
         #: seeded from a checkpoint at boot so a reboot skips
         #: reconstruction.
         self.window_plan: WindowPlan | None = None
+        #: Warm-start state: the previous epoch's converged scores and
+        #: the peer hash per score row (restored from checkpoints at
+        #: boot, so warm start survives restart).
+        self.last_scores: np.ndarray | None = None
+        self.last_peer_hashes: list[int] | None = None
+        #: Senders whose attestation changed since the window plan last
+        #: advanced — the delta-plan churn source.  Accumulates across
+        #: failed epochs; cleared per successful converge.
+        self._dirty_hashes: set[int] = set()
+        #: Hash per peer id of the most recent build_graph call.
+        self._id_order: list[int] = []
         _, self._group_pks = keyset_from_raw(self.config.fixed_set)
         self._group_hashes = [pk.hash() for pk in self._group_pks]
         # Poseidon pk-hash memo: hashing is 68 field-level rounds of
@@ -189,7 +235,9 @@ class Manager:
             raise EigenError.invalid_attestation("signature verification failed")
 
         obs_metrics.ATTESTATIONS_ACCEPTED.inc()
-        self.attestations[self._pk_hash(att.pk)] = att
+        h = self._pk_hash(att.pk)
+        self.attestations[h] = att
+        self._dirty_hashes.add(h)
 
     @staticmethod
     def _verify_sig(att: Attestation, message_hash: int) -> bool:
@@ -257,7 +305,9 @@ class Manager:
 
             for (i, att, _), ok in zip(candidates, sig_ok):
                 if ok:
-                    self.attestations[self._pk_hash(att.pk)] = att
+                    h = self._pk_hash(att.pk)
+                    self.attestations[h] = att
+                    self._dirty_hashes.add(h)
                     results[i] = IngestResult(True)
                     obs_metrics.ATTESTATIONS_ACCEPTED.inc()
                 else:
@@ -283,7 +333,9 @@ class Manager:
         for sk, pk, msg, row in zip(sks, pks, messages, scores):
             sig = sign(sk, pk, msg)
             att = Attestation(sig=sig, pk=pk, neighbours=list(pks), scores=list(row))
-            self.attestations[pk.hash()] = att
+            h = pk.hash()
+            self.attestations[h] = att
+            self._dirty_hashes.add(h)
 
     # -- per-epoch computation ------------------------------------------
 
@@ -334,17 +386,99 @@ class Manager:
             assert self.prover.verify(pub_ins, proof_bytes)
         self.cached_proofs[epoch] = Proof(pub_ins=pub_ins, proof=proof_bytes)
 
-    def converge_epoch(
-        self, epoch: Epoch, *, alpha: float = 0.0, tol: float = 1e-6, max_iter: int = 50
-    ) -> ConvergenceResult:
-        """Scaled path: build the open trust graph from every cached
-        attestation and converge it on the configured TrustBackend.
-        The graph used is kept as ``last_graph`` so checkpointing can
-        persist exactly the graph the scores belong to."""
+    def _warm_t0(self, id_order: list[int]) -> np.ndarray | None:
+        """Remap the previous epoch's fixed point onto the new graph's
+        id space: surviving peers keep their score, departed peers'
+        mass drops out, joined peers start at zero, and the result is
+        L1-renormalized.  None (cold start) when there is no previous
+        state or the overlap is empty — the backends treat None as
+        "start from the pre-trust vector"."""
+        if self.last_scores is None or self.last_peer_hashes is None:
+            return None
+        prev = {h: i for i, h in enumerate(self.last_peer_hashes)}
+        scores = self.last_scores
+        t0 = np.zeros(len(id_order), np.float64)
+        hits = 0
+        for i, h in enumerate(id_order):
+            j = prev.get(h)
+            if j is not None and j < len(scores):
+                t0[i] = max(float(scores[j]), 0.0)
+                hits += 1
+        total = t0.sum()
+        if hits == 0 or not np.isfinite(total) or total <= 0:
+            return None
+        return t0 / total
+
+    @contextmanager
+    def _plan_cache(self, backend, delta_rows: np.ndarray | None = None):
+        """THE plan-cache handoff: seed the backend from the manager's
+        cached WindowPlan (plus the churn hint for delta updates) and
+        read back whatever plan the converge actually used, so
+        checkpoints persist it.  Duck-typed — any backend exposing
+        ``plan``/``delta_rows``/``last_plan`` participates, which
+        covers both windowed rungs and future sharded composites
+        without name dispatch."""
+        if hasattr(backend, "plan"):
+            backend.plan = self.window_plan
+        if hasattr(backend, "delta_rows"):
+            backend.delta_rows = delta_rows
+        try:
+            yield backend
+        finally:
+            plan = getattr(backend, "last_plan", None)
+            if plan is not None:
+                self.window_plan = plan
+
+    def prepare_epoch(self, epoch: Epoch) -> PreparedEpoch:
+        """Host stage of one epoch: snapshot the dirty-sender set,
+        assemble the open graph, remap the warm-start seed, and derive
+        the plan-delta churn hint.  Touches no device state — the
+        pipeline overlaps this with the previous epoch's device work."""
+        # Snapshot BEFORE assembly: an ingest racing build_graph stays
+        # dirty for the next epoch (supersets are safe, misses are not).
+        dirty = set(self._dirty_hashes)
         with TRACER.span("build_graph"):
             graph = self.build_graph()
+        # A concurrent build_graph (pipelined checkpoint path) may have
+        # extended the shared order; ids are append-only, so truncating
+        # to this graph's peer count restores the matching column.
+        id_order = list(self._id_order)[: graph.n]
         obs_metrics.GRAPH_PEERS.set(graph.n)
         obs_metrics.GRAPH_EDGES.set(graph.nnz)
+        t0 = self._warm_t0(id_order) if self.config.warm_start else None
+        delta_rows = None
+        if self.window_plan is not None and dirty:
+            pos = {h: i for i, h in enumerate(id_order)}
+            rows = np.array(
+                sorted(pos[h] for h in dirty if h in pos), dtype=np.int64
+            )
+            # Above the churn crossover a full rebuild is cheaper than
+            # repacking that many windows (PERF.md §11).
+            if rows.size and rows.size <= self.config.plan_delta_max_churn * max(
+                graph.n, 1
+            ):
+                delta_rows = rows
+        return PreparedEpoch(
+            epoch=epoch,
+            graph=graph,
+            id_order=id_order,
+            t0=t0,
+            delta_rows=delta_rows,
+            dirty_snapshot=dirty,
+        )
+
+    def converge_prepared(
+        self,
+        prepared: PreparedEpoch,
+        *,
+        alpha: float = 0.0,
+        tol: float = 1e-6,
+        max_iter: int = 50,
+    ) -> ConvergenceResult:
+        """Device stage of one epoch: converge the prepared graph on the
+        configured TrustBackend, seeded warm and with the plan cache
+        handed off through :meth:`_plan_cache`."""
+        graph = prepared.graph
         backend = get_backend(self.config.backend)
         # The analyzer (`python -m protocol_tpu.analysis`) hard-gates
         # every backend in KERNEL_INVARIANTS; a configured backend
@@ -362,16 +496,19 @@ class Manager:
                 "its kernel access pattern is not lint-gated (PERF.md §9)",
                 self.config.backend,
             )
-        # Plan-carrying backends (tpu-windowed, tpu-sharded:tpu-windowed)
-        # expose plan/last_plan; seed from the manager's cache and keep
-        # whatever the converge actually used, so checkpoints persist it.
-        if hasattr(backend, "plan"):
-            backend.plan = self.window_plan
-        result = backend.converge(graph, alpha=alpha, tol=tol, max_iter=max_iter)
-        if getattr(backend, "last_plan", None) is not None:
-            self.window_plan = backend.last_plan
+        with self._plan_cache(backend, prepared.delta_rows):
+            result = backend.converge(
+                graph, alpha=alpha, tol=tol, max_iter=max_iter, t0=prepared.t0
+            )
+        if prepared.t0 is not None:
+            obs_metrics.WARM_START_APPLIED.inc()
+        # The epoch landed: its churn is folded into the cached plan
+        # (or the plan was rebuilt), so those senders are clean now.
+        self._dirty_hashes -= prepared.dirty_snapshot
         self.last_graph = graph
-        self.cached_results[epoch] = result
+        self.last_scores = result.scores
+        self.last_peer_hashes = prepared.id_order
+        self.cached_results[prepared.epoch] = result
         # Convergence health → the /metrics surface: the iteration
         # count, the final residual, and the full device-captured
         # trajectory (one observation per iteration, so the histogram's
@@ -382,6 +519,20 @@ class Manager:
             for r in result.residuals:
                 obs_metrics.CONVERGENCE_RESIDUAL.observe(float(r))
         return result
+
+    def converge_epoch(
+        self, epoch: Epoch, *, alpha: float = 0.0, tol: float = 1e-6, max_iter: int = 50
+    ) -> ConvergenceResult:
+        """Scaled path: build the open trust graph from every cached
+        attestation and converge it on the configured TrustBackend —
+        the sequential composition of :meth:`prepare_epoch` (host) and
+        :meth:`converge_prepared` (device); the epoch pipeline calls
+        the two halves from different stages instead.  The graph used
+        is kept as ``last_graph`` so checkpointing can persist exactly
+        the graph the scores belong to."""
+        return self.converge_prepared(
+            self.prepare_epoch(epoch), alpha=alpha, tol=tol, max_iter=max_iter
+        )
 
     def build_graph(self) -> TrustGraph:
         """Assemble the open COO graph: peer ids are discovered from
@@ -410,6 +561,9 @@ class Manager:
                 dst.append(d_id)
                 w.append(float(score))
         n = len(ids)
+        # id -> hash, assembly order: the warm-start remap and the
+        # checkpoint's peer_hashes column both key scores by this.
+        self._id_order = list(ids)
         pre = np.zeros(n, bool)
         pre[: len(self._group_hashes)] = True
         return TrustGraph(

@@ -133,6 +133,10 @@ class Node:
     manager: Manager
     _server: asyncio.AbstractServer | None = field(default=None, repr=False)
     _tasks: list = field(default_factory=list, repr=False)
+    #: Double-buffered epoch engine (config.epoch_pipeline): host
+    #: stages of epoch k+1 overlap device converge + proving of epoch
+    #: k; None in sequential mode.
+    _pipeline: object | None = field(default=None, repr=False)
 
     @classmethod
     def from_config(cls, config: ProtocolConfig) -> "Node":
@@ -141,6 +145,8 @@ class Node:
                 backend=config.trust_backend,
                 prover=config.prover,
                 srs_path=config.srs_path,
+                warm_start=config.warm_start,
+                plan_delta_max_churn=config.plan_delta_max_churn,
             )
         )
         return cls(config=config, manager=manager)
@@ -229,30 +235,79 @@ class Node:
                     result.residual,
                     result.backend,
                 )
-            if self.config.checkpoint_dir:
-                from .checkpoint import CheckpointStore
-
-                # Persist exactly the graph the scores were computed on
-                # (ingest keeps mutating the attestation cache concurrently;
-                # a rebuilt graph could have more peers than scores).
-                graph = self.manager.last_graph if scores is not None else self.manager.build_graph()
-                proof_json = (
-                    self.manager.get_proof(epoch)
-                    .to_raw(backend=_backend_tag(self.manager))
-                    .to_json()
-                )
-                with TELEMETRY.timer("epoch.checkpoint"), TRACER.span("checkpoint"):
-                    CheckpointStore(self.config.checkpoint_dir).save(
-                        epoch,
-                        graph,
-                        scores,
-                        proof_json,
-                        # tpu-windowed only: the one-time bucketing plan, so
-                        # a reboot revalidates instead of rebuilding it.
-                        plan=self.manager.window_plan,
-                    )
+            self._checkpoint_epoch(epoch, scores)
         TELEMETRY.count("epochs")
         obs_metrics.EPOCHS_TOTAL.inc()
+
+    def _checkpoint_epoch(self, epoch: Epoch, scores) -> None:
+        """Snapshot the epoch (graph + scores + proof + windowed plan +
+        the peer-hash column that keys the warm-start remap) when a
+        checkpoint dir is configured; shared by the sequential tick and
+        the pipelined device stage."""
+        if not self.config.checkpoint_dir:
+            return
+        from .checkpoint import CheckpointStore
+
+        # Persist exactly the graph the scores were computed on
+        # (ingest keeps mutating the attestation cache concurrently;
+        # a rebuilt graph could have more peers than scores).
+        graph = (
+            self.manager.last_graph if scores is not None else self.manager.build_graph()
+        )
+        proof_json = (
+            self.manager.get_proof(epoch)
+            .to_raw(backend=_backend_tag(self.manager))
+            .to_json()
+        )
+        with TELEMETRY.timer("epoch.checkpoint"), TRACER.span("checkpoint"):
+            CheckpointStore(self.config.checkpoint_dir).save(
+                epoch,
+                graph,
+                scores,
+                proof_json,
+                # tpu-windowed only: the one-time bucketing plan, so
+                # a reboot revalidates instead of rebuilding it.
+                plan=self.manager.window_plan,
+                peer_hashes=(
+                    self.manager.last_peer_hashes if scores is not None else None
+                ),
+            )
+
+    def _pipeline_device_stage(self, prepared):
+        """Device half of a pipelined epoch: prove → converge (from the
+        prepared graph/warm seed) → checkpoint, under the epoch's trace
+        root.  Host assembly already happened in
+        ``Manager.prepare_epoch`` on the submit side — by the time this
+        runs, the next epoch's host stage may already be executing."""
+        epoch = prepared.epoch
+        with TRACER.epoch(epoch.number):
+            with TELEMETRY.timer("epoch.calculate_proofs"), TRACER.span("prove"):
+                self.manager.calculate_proofs(epoch)
+            scores = None
+            result = None
+            if self.manager.config.backend != "native-cpu":
+                profile_dir = (
+                    f"{self.config.profile_dir}/epoch_{epoch.number}"
+                    if self.config.profile_dir
+                    else None
+                )
+                with TELEMETRY.timer("epoch.converge_open_graph"):
+                    with profile_session(profile_dir):
+                        result = self.manager.converge_prepared(prepared, alpha=0.1)
+                scores = result.scores
+                log.info(
+                    "epoch %s: open graph n=%d converged in %d iters (resid %.2e) on %s%s",
+                    epoch,
+                    len(result.scores),
+                    result.iterations,
+                    result.residual,
+                    result.backend,
+                    " [warm]" if prepared.t0 is not None else "",
+                )
+            self._checkpoint_epoch(epoch, scores)
+        TELEMETRY.count("epochs")
+        obs_metrics.EPOCHS_TOTAL.inc()
+        return result
 
     async def _epoch_loop(self, warm=None):
         if warm is not None:
@@ -275,12 +330,24 @@ class Node:
                 )
             last_epoch = epoch.number
             try:
-                # Proving may outlast the interval; the next sleep
-                # targets the *next* boundary from now = Skip semantics.
-                await asyncio.get_running_loop().run_in_executor(
-                    None, self._epoch_tick, epoch
-                )
-                log.info("epoch %s: proof cached", epoch)
+                if self._pipeline is not None:
+                    # Pipelined: only the host stage (graph assembly,
+                    # warm remap, plan delta) runs here; the device
+                    # stage overlaps with the NEXT boundary's host
+                    # work.  A busy device coalesces queued epochs
+                    # instead of dropping ticks.
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._pipeline.submit, epoch
+                    )
+                    log.info("epoch %s: submitted to pipeline", epoch)
+                else:
+                    # Proving may outlast the interval; the next sleep
+                    # targets the *next* boundary from now = Skip
+                    # semantics.
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._epoch_tick, epoch
+                    )
+                    log.info("epoch %s: proof cached", epoch)
             except Exception as e:
                 log.error("epoch %s: %r", epoch, e)
 
@@ -328,18 +395,33 @@ class Node:
             self.manager.cached_proofs[snapshot.epoch] = proof
         self.manager.last_graph = snapshot.graph
         self.manager.window_plan = snapshot.plan
+        # Warm-start state: the checkpointed fixed point plus its
+        # peer-hash column, so the first epoch after reboot converges
+        # from near-fixed-point instead of cold (PERF.md §11).
+        if snapshot.scores is not None and snapshot.peer_hashes is not None:
+            self.manager.last_scores = snapshot.scores
+            self.manager.last_peer_hashes = snapshot.peer_hashes
         log.info(
-            "restored checkpoint: epoch %s, %d peers%s%s",
+            "restored checkpoint: epoch %s, %d peers%s%s%s",
             snapshot.epoch,
             snapshot.graph.n,
             ", proof available" if snapshot.proof_json else "",
             ", windowed plan restored" if snapshot.plan is not None else "",
+            ", warm-start scores restored"
+            if snapshot.scores is not None and snapshot.peer_hashes is not None
+            else "",
         )
 
     async def start(self) -> None:
         if self.config.checkpoint_dir:
             self._restore_checkpoint()
         self.manager.generate_initial_attestations()
+        if self.config.epoch_pipeline:
+            from .pipeline import EpochPipeline
+
+            self._pipeline = EpochPipeline(
+                self.manager, device_stage=self._pipeline_device_stage
+            ).start()
         # Boot-time keygen, like the reference's MANAGER_STORE init
         # (server/src/main.rs:70-83): runs in an executor so the HTTP
         # socket comes up while the (cached ~0.7 s / cold ~13 s) PLONK
@@ -360,6 +442,12 @@ class Node:
     async def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self._pipeline is not None:
+            # Let in-flight device work land (bounded), then stop the
+            # worker; run off-loop so a slow prover can't stall stop().
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._pipeline.close(drain=True, timeout=30.0)
+            )
         if self._server:
             self._server.close()
             await self._server.wait_closed()

@@ -324,6 +324,27 @@ PLAN_REUSES = METRICS.counter(
     "eigentrust_window_plan_reuses_total",
     "Converges that reused a cached/restored WindowPlan",
 )
+PLAN_OUTCOMES = METRICS.counter(
+    "eigentrust_window_plan_outcomes_total",
+    "Per-converge WindowPlan resolution by outcome: reuse (fingerprint "
+    "hit), delta (churn folded in via apply_delta), rebuild (full "
+    "host construction)",
+    labelnames=("outcome",),
+)
+EPOCH_TICKS_COALESCED = METRICS.counter(
+    "eigentrust_epoch_ticks_coalesced_total",
+    "Epoch ticks superseded by a newer one while waiting in the "
+    "pipeline queue (backpressure: a slow device stage coalesces "
+    "pending epochs into the latest instead of dropping them)",
+)
+PIPELINE_QUEUE_DEPTH = METRICS.gauge(
+    "eigentrust_pipeline_queue_depth",
+    "Prepared epochs waiting for the device stage (bounded queue)",
+)
+WARM_START_APPLIED = METRICS.counter(
+    "eigentrust_warm_start_applied_total",
+    "Epoch convergences seeded from the previous epoch's fixed point",
+)
 PHASE_SECONDS = METRICS.histogram(
     "eigentrust_phase_seconds",
     "Span durations by phase name (every closed obs span lands here)",
@@ -355,5 +376,9 @@ __all__ = [
     "CHECKPOINT_RESTORES",
     "PLAN_REBUILDS",
     "PLAN_REUSES",
+    "PLAN_OUTCOMES",
+    "EPOCH_TICKS_COALESCED",
+    "PIPELINE_QUEUE_DEPTH",
+    "WARM_START_APPLIED",
     "PHASE_SECONDS",
 ]

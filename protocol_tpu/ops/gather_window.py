@@ -138,6 +138,7 @@ def bucket_by_window(
     *,
     dst: np.ndarray | None = None,
     n_dst: int | None = None,
+    spare_rows: int | None = 0,
 ) -> dict:
     """Group edges so each 1024-edge vreg-row shares one src window.
 
@@ -162,6 +163,14 @@ def bucket_by_window(
     cumulative-count placement — the previous per-window Python loop
     was ~34 s at 50M edges; this formulation is bounded by the sort's
     payload movement (<5 s measured, PERF.md §7).
+
+    ``spare_rows`` reserves that many zero-weight vreg-rows past the
+    packed data (on top of the BLOCK_ROWS grid rounding) — headroom
+    ``WindowPlan.apply_delta`` allocates overflow rows from (and where
+    the inert segment-table pads end), so a window outgrowing its
+    original padding doesn't force a full rebuild (PERF.md §11).
+    None sizes it adaptively: one grid block or ~6% of the data rows,
+    whichever is larger.
     """
     e = src.shape[0]
     if e == 0:
@@ -228,7 +237,9 @@ def bucket_by_window(
     rows_per = -(-counts // ROW)
     row_offset = np.concatenate([[0], np.cumsum(rows_per)]).astype(np.int64)
     n_data_rows = int(row_offset[-1])
-    total_rows = -(-n_data_rows // BLOCK_ROWS) * BLOCK_ROWS
+    if spare_rows is None:
+        spare_rows = max(BLOCK_ROWS, n_data_rows // 16)
+    total_rows = -(-(n_data_rows + spare_rows) // BLOCK_ROWS) * BLOCK_ROWS
     # Flat slot of each window-sorted edge: consecutive within its
     # window, starting at the window's first (fresh) vreg-row.  One
     # repeat over the per-window pad shift; the scatter below is
@@ -253,6 +264,8 @@ def bucket_by_window(
         "order": order,
         "out_pos": out_pos,
         "n_rows": total_rows,
+        "n_data_rows": n_data_rows,
+        "row_offset": row_offset,
     }
     if ds is None:
         return result
@@ -288,6 +301,7 @@ def bucket_by_window(
         seg_first=seg_first,
         seg_perm=seg_perm.astype(np.int32, copy=False),
         dst_ptr=dst_ptr.astype(np.int32),
+        seg_dst=np.ascontiguousarray(seg_dst, dtype=np.int32),
         n_segments=int(seg_dst.shape[0]),
     )
     return result
@@ -361,10 +375,89 @@ def gather_windowed(
 #: WindowPlan on-disk/in-memory layout version.  v1 stored dst-sorted
 #: ``seg_start``/``seg_end`` boundary pairs (4 random gathers per
 #: iteration); v2 is the interleaved single-pass layout (bucket-order
-#: ``seg_end`` + row-leading mask + folded dst permutation, PERF.md §8).
-#: Checkpoint-restored plans of any other version are discarded and
-#: rebuilt — the same path a fingerprint mismatch takes.
-PLAN_VERSION = 2
+#: ``seg_end`` + row-leading mask + folded dst permutation, PERF.md §8);
+#: v3 adds the host-side delta-update bookkeeping (bucket-order
+#: ``seg_dst``, per-window ``row_offset``, the live-row watermark, and
+#: the fingerprint lineage chain, PERF.md §11).  Checkpoint-restored
+#: plans of any other version are discarded and rebuilt — the same
+#: path a fingerprint mismatch takes.
+PLAN_VERSION = 3
+
+#: Ancestor fingerprints a delta-updated plan remembers (checkpoint
+#: forensics: how many epochs of churn separate this layout from its
+#: last from-scratch build).
+LINEAGE_DEPTH = 16
+
+#: Device segment tables are padded to a multiple of this, with at
+#: least SEG_HEADROOM free entries, so per-epoch deltas that grow the
+#: run count slightly keep every device array shape — and therefore
+#: the compiled convergence kernel — stable.  Pad runs are inert: they
+#: end in the zero-weight spare tail (partial ≡ 0) and the dst
+#: permutation parks them beyond ``dst_ptr[n]``, so ``rowsum_sorted``
+#: never differences them into any destination (the same trick the
+#: sharded partition uses for its per-shard padding).
+SEG_QUANTUM = 1024
+SEG_HEADROOM = 256
+
+
+class PlanDeltaError(ValueError):
+    """The requested delta cannot be applied to this plan (peer set
+    shrank, a deleted edge is absent, or the overflow headroom is
+    exhausted) — callers fall back to a full ``build_window_plan``."""
+
+
+def _pad_segment_tables(
+    seg_end: np.ndarray,
+    seg_first: np.ndarray,
+    seg_dst: np.ndarray,
+    *,
+    capacity: int,
+    n: int,
+    n_rows: int,
+    n_data_rows: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Pad the live bucket-order run tables to ``capacity`` device
+    entries and fold the dst sort: pad runs end at the topmost
+    zero-weight spare slots (strictly above every live run, so the
+    boundary read stays sorted and their partials are exact zeros) and
+    carry sentinel dst ``n``, which the counting sort parks beyond
+    ``dst_ptr[n]`` — never reduced into any destination.  Returns
+    ``(seg_end, seg_first, seg_perm, dst_ptr)`` at device capacity."""
+    s = int(seg_end.shape[0])
+    pad = capacity - s
+    if pad < 0 or pad > (n_rows - n_data_rows) * ROW:
+        raise PlanDeltaError(
+            f"segment capacity {capacity} does not fit the spare-slot headroom"
+        )
+    total_slots = n_rows * ROW
+    end = np.concatenate(
+        [
+            seg_end.astype(np.int64),
+            np.arange(total_slots - pad, total_slots, dtype=np.int64),
+        ]
+    )
+    first = np.concatenate([seg_first.astype(bool), np.ones(pad, bool)])
+    key = np.concatenate([seg_dst.astype(np.int64), np.full(pad, n, np.int64)])
+    perm, counts, _ = _counting_sort(np.ascontiguousarray(key, np.int32), n + 1)
+    dst_ptr = np.zeros(n + 1, np.int64)
+    np.cumsum(counts[:n], out=dst_ptr[1:])
+    return (
+        end.astype(np.int32),
+        first,
+        np.asarray(perm, np.int32),
+        dst_ptr.astype(np.int32),
+    )
+
+
+def _segment_capacity(s: int, max_pad_slots: int) -> int:
+    """Quantized device capacity for ``s`` live runs: proportional
+    growth headroom (churn fragments hub runs into singletons, so the
+    live count drifts up by roughly the per-epoch rewire count —
+    ~12.5% absorbs several epochs between regrowths), rounded to
+    SEG_QUANTUM, clamped to the spare-tail slots actually available
+    for pad runs."""
+    slack = max(SEG_HEADROOM, s // 8)
+    return min(-(-(s + slack) // SEG_QUANTUM) * SEG_QUANTUM, s + max_pad_slots)
 
 
 @dataclass
@@ -374,15 +467,21 @@ class WindowPlan:
     Built once on the host (``build_window_plan``), reused every
     iteration and across epochs while the graph fingerprint matches;
     persisted by ``node/checkpoint.py`` so a node reboot doesn't re-pay
-    construction.  ``order``/``out_pos`` map bucket slots back to input
-    edges — needed only by tests and diagnostics, so checkpoints omit
-    them (``to_arrays(core_only=True)``).
+    construction.  Small per-epoch edge churn is folded in by
+    ``apply_delta`` (touched windows repacked in place, everything else
+    shared) instead of a full rebuild — the ``lineage`` chain records
+    the ancestor fingerprints of such delta-updated plans.
+    ``order``/``out_pos`` map bucket slots back to input edges — needed
+    only by tests and diagnostics, so checkpoints omit them
+    (``to_arrays(core_only=True)``); delta-updated plans drop them.
     """
 
     n: int  # peers (dense output length)
     n_rows: int  # padded vreg-rows
     table_entries: int  # score table padded to a WINDOW multiple
     n_segments: int  # per-(row, dst) runs crossing the bridge
+    n_data_rows: int  # live vreg-rows (original packing + delta overflow)
+    n_edges: int  # live edges encoded (delta-integrity tripwire)
     wid: np.ndarray  # (n_rows,) int32 window id per vreg-row
     local: np.ndarray  # (n_rows*8, 128) int32 window-local indices
     weight: np.ndarray  # (n_rows*8, 128) f32 slot weights (0 = padding)
@@ -390,21 +489,38 @@ class WindowPlan:
     seg_first: np.ndarray  # (S,) bool run is row-leading (start prefix = 0)
     seg_perm: np.ndarray  # (S,) int32 bucket→dst permutation of partials
     dst_ptr: np.ndarray  # (n+1,) int32 run range per destination
+    seg_dst: np.ndarray  # (S,) int32 run destination, bucket order (host-side)
+    row_offset: np.ndarray  # (n_windows+1,) int64 original rows per window
     fingerprint: str  # graph identity for safe reuse
     version: int = PLAN_VERSION  # layout version (see PLAN_VERSION)
+    #: Fingerprints of the plans this one was delta-derived from,
+    #: oldest first, capped at LINEAGE_DEPTH; empty for a from-scratch
+    #: build.  Persisted with checkpoints (delta provenance).
+    lineage: tuple[str, ...] = ()
     order: np.ndarray | None = None  # (E,) bucket position k ← edge order[k]
     out_pos: np.ndarray | None = None  # (E,) slot of edge order[k]
 
+    #: Device operands, in ``converge_windowed`` order — exactly what
+    #: crosses the host→HBM boundary.
     _CORE = ("wid", "local", "weight", "seg_end", "seg_first", "seg_perm", "dst_ptr")
-    _META = ("n", "n_rows", "table_entries", "n_segments")
+    #: Host-only bookkeeping for ``apply_delta`` (persisted, never
+    #: shipped to the device).
+    _HOST = ("seg_dst", "row_offset")
+    _META = ("n", "n_rows", "table_entries", "n_segments", "n_data_rows", "n_edges")
 
     @property
     def compression(self) -> float:
         """Edge contributions per bridge partial (E / n_segments) —
         how much the run-level reduction shrinks the random-access
         volume vs a per-edge bucket→dst permutation."""
-        e = int(np.count_nonzero(self.weight)) if self.order is None else len(self.order)
-        return e / max(self.n_segments, 1)
+        return self.n_edges / max(self.n_segments, 1)
+
+    @property
+    def seg_capacity(self) -> int:
+        """Device length of the segment tables: ``n_segments`` live
+        runs plus inert pad runs (shape-stability headroom for
+        ``apply_delta`` — see SEG_QUANTUM)."""
+        return int(self.seg_end.shape[0])
 
     def device_args(self) -> tuple:
         """Core arrays as device arrays, in ``converge_windowed`` order."""
@@ -415,7 +531,8 @@ class WindowPlan:
         out = {k: np.int64(getattr(self, k)) for k in self._META}
         out["version"] = np.int64(self.version)
         out["fingerprint"] = np.bytes_(self.fingerprint.encode())
-        for k in self._CORE:
+        out["lineage"] = np.array(list(self.lineage), dtype="S64")
+        for k in self._CORE + self._HOST:
             out[k] = getattr(self, k)
         if not core_only and self.order is not None:
             out["order"] = self.order
@@ -435,11 +552,302 @@ class WindowPlan:
             )
         return cls(
             **{k: int(z[k]) for k in cls._META},
-            **{k: np.asarray(z[k]) for k in cls._CORE},
+            **{k: np.asarray(z[k]) for k in cls._CORE + cls._HOST},
             fingerprint=bytes(z["fingerprint"]).decode(),
             version=version,
+            lineage=tuple(bytes(x).decode() for x in z["lineage"])
+            if "lineage" in z
+            else (),
             order=np.asarray(z["order"]) if "order" in z else None,
             out_pos=np.asarray(z["out_pos"]) if "out_pos" in z else None,
+        )
+
+    # -- delta updates (PERF.md §11) ------------------------------------
+
+    def _window_vreg_rows(self, window: int) -> np.ndarray:
+        """Live vreg-rows carrying ``window``'s slots, ascending: the
+        original contiguous block plus any delta-appended overflow rows
+        (overflow lives past ``row_offset[-1]``, identified by wid)."""
+        n_orig = int(self.row_offset[-1])
+        if window + 1 < len(self.row_offset):
+            rows = np.arange(
+                self.row_offset[window], self.row_offset[window + 1], dtype=np.int64
+            )
+        else:
+            rows = np.empty(0, np.int64)
+        if self.n_data_rows > n_orig:
+            tail = np.arange(n_orig, self.n_data_rows, dtype=np.int64)
+            rows = np.concatenate([rows, tail[self.wid[tail] == window]])
+        return rows
+
+    def _segments_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Indices into the bucket-order segment table of every run
+        living in ``rows`` — seg_end is strictly increasing, so each
+        row's runs are one searchsorted slice."""
+        end = self.seg_end.astype(np.int64)
+        lo = np.searchsorted(end, rows * ROW, side="left")
+        hi = np.searchsorted(end, (rows + 1) * ROW - 1, side="right")
+        parts = [np.arange(a, b, dtype=np.int64) for a, b in zip(lo, hi) if b > a]
+        if not parts:
+            return np.empty(0, np.int64)
+        return np.concatenate(parts)
+
+    def _edges_of_segments(
+        self, idx: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Recover ``(src, dst, w)`` of the edges inside the given runs
+        by expanding each run's slot range — the inverse of the packing
+        ``bucket_by_window`` performed."""
+        if idx.size == 0:
+            z = np.empty(0, np.int32)
+            return z, z, np.empty(0, np.float32)
+        end = self.seg_end.astype(np.int64)
+        start = np.where(
+            self.seg_first[idx],
+            (end[idx] // ROW) * ROW,
+            end[np.maximum(idx, 1) - 1] + 1,
+        )
+        lens = end[idx] - start + 1
+        total = int(lens.sum())
+        run_of = np.repeat(np.cumsum(lens) - lens, lens)
+        slots = np.repeat(start, lens) + (np.arange(total, dtype=np.int64) - run_of)
+        dst = np.repeat(self.seg_dst[idx], lens)
+        rows = slots // ROW
+        src = (
+            self.wid[rows].astype(np.int64) * WINDOW
+            + self.local.reshape(-1)[slots]
+        ).astype(np.int32)
+        return src, dst, self.weight.reshape(-1)[slots]
+
+    def recovered_edges(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The full ``(src, dst, w)`` edge list this plan encodes, in
+        slot (bucket) order — the layout-semantics ground truth the
+        delta property tests compare against a from-scratch rebuild."""
+        return self._edges_of_segments(np.arange(self.n_segments, dtype=np.int64))
+
+    def apply_delta(
+        self,
+        inserts: tuple[np.ndarray, np.ndarray, np.ndarray] | None,
+        deletes: tuple[np.ndarray, np.ndarray] | None,
+        *,
+        n: int | None = None,
+        fingerprint: str,
+    ) -> "WindowPlan":
+        """Incrementally fold an edge delta into the layout, returning a
+        NEW plan (arrays are copied where touched, shared elsewhere —
+        the old plan stays valid for the in-flight epoch).
+
+        ``inserts`` is ``(src, dst, w)`` of edges to add (normalized
+        weights), ``deletes`` is ``(src, dst)`` of edges to remove; ``n``
+        grows the peer set (new peers join with no plan presence until
+        an insert names them).  Host-side cost: O(Δ log Δ) sorting over
+        the delta plus a repack of the touched windows' slots, then two
+        streaming O(S) passes (segment-table splice + the dst counting
+        sort behind ``seg_perm``/``dst_ptr``) — far below the full
+        rebuild's O(E) counting sorts.  The result's ``fingerprint`` is
+        the caller-supplied identity of the post-delta graph and the
+        predecessor chain lands in ``lineage``.
+
+        Raises :class:`PlanDeltaError` when the delta cannot be folded
+        (peer set shrank, a deleted edge is absent, or a window outgrew
+        the spare-row headroom) — callers fall back to
+        ``build_window_plan``.
+        """
+        empty_i = (np.empty(0, np.int32),) * 2 + (np.empty(0, np.float32),)
+        ins_src, ins_dst, ins_w = (
+            tuple(np.asarray(a) for a in inserts) if inserts is not None else empty_i
+        )
+        del_src, del_dst = (
+            tuple(np.asarray(a, np.int64) for a in deletes)
+            if deletes is not None
+            else (np.empty(0, np.int64),) * 2
+        )
+        ins_src = np.asarray(ins_src, np.int64)
+        ins_dst = np.asarray(ins_dst, np.int64)
+        ins_w = np.asarray(ins_w, np.float32)
+        n_new = self.n if n is None else int(n)
+        if n_new < self.n:
+            raise PlanDeltaError("peer set shrank; rebuild the plan")
+        for a in (ins_src, ins_dst, del_src, del_dst):
+            if a.size and (int(a.min()) < 0 or int(a.max()) >= n_new):
+                raise PlanDeltaError("delta edge index outside [0, n)")
+        table_entries = -(-n_new // WINDOW) * WINDOW
+        n_windows = table_entries // WINDOW
+        row_offset = self.row_offset
+        if n_windows + 1 > len(row_offset):
+            # New windows own no original rows; overflow allocation
+            # below serves them like any outgrown window.
+            row_offset = np.concatenate(
+                [
+                    row_offset,
+                    np.full(n_windows + 1 - len(row_offset), row_offset[-1], np.int64),
+                ]
+            )
+
+        touched = np.unique(np.concatenate([ins_src, del_src]) >> _WIN_BITS)
+        wid = self.wid.copy()
+        local = self.local.reshape(-1).copy()
+        weight = self.weight.reshape(-1).copy()
+        n_data_rows = self.n_data_rows
+
+        # Segments whose rows stay untouched survive verbatim; the
+        # touched windows' runs are rebuilt below.  Only live runs
+        # participate — the inert device pads are regenerated at exit.
+        row_window = wid.astype(np.int64).copy()
+        row_window[self.n_data_rows :] = -1
+        end_live = self.seg_end.astype(np.int64)[: self.n_segments]
+        first_live = self.seg_first[: self.n_segments]
+        seg_win = row_window[end_live // ROW]
+        keep = ~np.isin(seg_win, touched)
+        new_end: list[np.ndarray] = [end_live[keep]]
+        new_first: list[np.ndarray] = [first_live[keep]]
+        new_dst: list[np.ndarray] = [self.seg_dst.astype(np.int64)[keep]]
+
+        iw = ins_src >> _WIN_BITS
+        dw = del_src >> _WIN_BITS
+        for w in touched.tolist():
+            rows_w = self._window_vreg_rows(int(w))
+            osrc, odst, ow = self._edges_of_segments(self._segments_of_rows(rows_w))
+            # Delete by (src, dst) identity; duplicate edges are a
+            # multiset — each delete consumes one instance.
+            dm = dw == w
+            if dm.any():
+                okey = osrc.astype(np.int64) << 32 | odst.astype(np.int64)
+                dkey = np.sort(del_src[dm] << 32 | del_dst[dm])
+                order = np.argsort(okey, kind="stable")
+                sk = okey[order]
+                pos = np.searchsorted(sk, dkey, side="left")
+                # The i-th duplicate of a delete key consumes the i-th
+                # plan instance of that edge.
+                grp = np.concatenate([[True], dkey[1:] != dkey[:-1]])
+                first = np.nonzero(grp)[0][np.cumsum(grp) - 1]
+                take = pos + (np.arange(len(dkey)) - first)
+                if take.size and (
+                    int(take.max()) >= len(sk) or not (sk[take] == dkey).all()
+                ):
+                    raise PlanDeltaError("delete names an edge absent from the plan")
+                drop = np.zeros(len(okey), bool)
+                drop[order[take]] = True
+                osrc, odst, ow = osrc[~drop], odst[~drop], ow[~drop]
+            im = iw == w
+            if im.any():
+                osrc = np.concatenate([osrc, ins_src[im].astype(np.int32)])
+                odst = np.concatenate([odst, ins_dst[im].astype(np.int32)])
+                ow = np.concatenate([ow, ins_w[im]])
+            count = osrc.shape[0]
+            # Zero the window's slots, then repack dst-sorted from the
+            # first row — the run differencing needs gap-free packing.
+            if rows_w.size:
+                slots_w = (rows_w[:, None] * ROW + np.arange(ROW)[None, :]).reshape(-1)
+                local[slots_w] = 0
+                weight[slots_w] = 0.0
+            if count > rows_w.size * ROW:
+                extra = -(-(count - rows_w.size * ROW) // ROW)
+                if n_data_rows + extra > self.n_rows:
+                    raise PlanDeltaError(
+                        f"window {w} outgrew the spare-row headroom; rebuild"
+                    )
+                grown = np.arange(n_data_rows, n_data_rows + extra, dtype=np.int64)
+                wid[grown] = w
+                n_data_rows += extra
+                rows_w = np.concatenate([rows_w, grown])
+            if count == 0:
+                continue
+            order = np.argsort(odst, kind="stable")
+            d = odst[order].astype(np.int64)
+            slots = rows_w[np.arange(count) // ROW] * ROW + np.arange(count) % ROW
+            local[slots] = (osrc[order] & (WINDOW - 1)).astype(np.int32)
+            weight[slots] = ow[order]
+            lead = np.arange(count) % ROW == 0
+            brk = np.empty(count, bool)
+            brk[0] = True
+            brk[1:] = (d[1:] != d[:-1]) | lead[1:]
+            endm = np.empty(count, bool)
+            endm[-1] = True
+            endm[:-1] = brk[1:]
+            new_end.append(slots[endm])
+            new_first.append(lead[brk])
+            new_dst.append(d[brk])
+
+        all_end = np.concatenate(new_end)
+        order = np.argsort(all_end, kind="stable")
+        live_end = all_end[order]
+        if live_end.size > 1 and not (np.diff(live_end) > 0).all():
+            raise AssertionError("delta produced overlapping runs (plan bug)")
+        live_first = np.concatenate(new_first)[order]
+        seg_dst = np.concatenate(new_dst)[order].astype(np.int32)
+        # Keep the device capacity (and so every array shape + the
+        # compiled kernel) whenever the new run count still fits; grow
+        # by whole quanta otherwise — one recompile, then stable again.
+        s_new = int(seg_dst.shape[0])
+        max_pad = (self.n_rows - n_data_rows) * ROW
+        capacity = self.seg_capacity
+        if s_new > capacity or capacity - s_new > max_pad:
+            capacity = _segment_capacity(s_new, max_pad)
+        seg_end, seg_first, seg_perm, dst_ptr = _pad_segment_tables(
+            live_end,
+            live_first,
+            seg_dst,
+            capacity=capacity,
+            n=n_new,
+            n_rows=self.n_rows,
+            n_data_rows=n_data_rows,
+        )
+        return WindowPlan(
+            n=n_new,
+            n_rows=self.n_rows,
+            table_entries=table_entries,
+            n_segments=int(seg_dst.shape[0]),
+            n_data_rows=n_data_rows,
+            n_edges=self.n_edges - int(del_src.size) + int(ins_src.size),
+            wid=wid,
+            local=local.reshape(self.local.shape),
+            weight=weight.reshape(self.weight.shape),
+            seg_end=seg_end.astype(np.int32),
+            seg_first=seg_first,
+            seg_perm=seg_perm.astype(np.int32, copy=False),
+            dst_ptr=dst_ptr.astype(np.int32),
+            seg_dst=seg_dst,
+            row_offset=row_offset,
+            fingerprint=fingerprint,
+            lineage=(self.lineage + (self.fingerprint,))[-LINEAGE_DEPTH:],
+        )
+
+    def replace_rows(
+        self,
+        rows: np.ndarray,
+        new_src: np.ndarray,
+        new_dst: np.ndarray,
+        new_w: np.ndarray,
+        *,
+        n: int | None = None,
+        fingerprint: str,
+    ) -> "WindowPlan":
+        """Replace every out-edge of the given source peers with the
+        supplied (normalized) edges — the natural delta unit, because
+        row normalization makes any change to a peer's attestation
+        rewrite that peer's whole out-row.  Deletes are recovered from
+        the plan itself, so callers need no copy of the previous edge
+        list.  Raises :class:`PlanDeltaError` like ``apply_delta``."""
+        rows = np.unique(np.asarray(rows, np.int64))
+        new_src = np.asarray(new_src, np.int64)
+        if new_src.size and not np.isin(new_src, rows).all():
+            raise PlanDeltaError("replacement edge outside the replaced rows")
+        parts = [
+            self._edges_of_segments(
+                self._segments_of_rows(self._window_vreg_rows(int(w)))
+            )
+            for w in np.unique(rows >> _WIN_BITS).tolist()
+        ]
+        if parts:
+            osrc = np.concatenate([p[0] for p in parts])
+            odst = np.concatenate([p[1] for p in parts])
+            m = np.isin(osrc.astype(np.int64), rows)
+            deletes = (osrc[m], odst[m])
+        else:
+            deletes = None
+        return self.apply_delta(
+            (new_src, new_dst, new_w), deletes, n=n, fingerprint=fingerprint
         )
 
 
@@ -457,27 +865,105 @@ def graph_fingerprint(n: int, src: np.ndarray, dst: np.ndarray, w: np.ndarray) -
 
 
 def build_window_plan(
-    src: np.ndarray, dst: np.ndarray, w: np.ndarray, *, n: int
+    src: np.ndarray,
+    dst: np.ndarray,
+    w: np.ndarray,
+    *,
+    n: int,
+    spare_rows: int | None = None,
 ) -> WindowPlan:
     """One-time host construction of the fused-pipeline layout for a
-    row-normalized, self-edge-free edge list."""
-    b = bucket_by_window(src, w, table_size=n, dst=dst, n_dst=n)
+    row-normalized, self-edge-free edge list.  ``spare_rows`` of
+    zero-weight tail headroom (adaptive by default: one grid block or
+    ~6% of the data rows) lets ``apply_delta`` absorb window growth —
+    and segment-table fragmentation — across epochs without a rebuild
+    or a device-shape change."""
+    b = bucket_by_window(
+        src, w, table_size=n, dst=dst, n_dst=n, spare_rows=spare_rows
+    )
+    # Device segment tables at quantized capacity: the inert pads give
+    # apply_delta shape-stability headroom (no recompile per epoch).
+    max_pad = (b["n_rows"] - b["n_data_rows"]) * ROW
+    seg_end, seg_first, seg_perm, dst_ptr = _pad_segment_tables(
+        b["seg_end"],
+        b["seg_first"],
+        b["seg_dst"],
+        capacity=_segment_capacity(b["n_segments"], max_pad),
+        n=n,
+        n_rows=b["n_rows"],
+        n_data_rows=b["n_data_rows"],
+    )
     return WindowPlan(
         n=n,
         n_rows=b["n_rows"],
         table_entries=-(-n // WINDOW) * WINDOW,
         n_segments=b["n_segments"],
+        n_data_rows=b["n_data_rows"],
+        n_edges=int(src.shape[0]),
         wid=b["wid"],
         local=b["local"],
         weight=b["weight"],
-        seg_end=b["seg_end"],
-        seg_first=b["seg_first"],
-        seg_perm=b["seg_perm"],
-        dst_ptr=b["dst_ptr"],
+        seg_end=seg_end,
+        seg_first=seg_first,
+        seg_perm=seg_perm,
+        dst_ptr=dst_ptr,
+        seg_dst=b["seg_dst"],
+        row_offset=b["row_offset"],
         fingerprint=graph_fingerprint(n, src, dst, w),
         order=b["order"],
         out_pos=b["out_pos"],
     )
+
+
+def try_plan_delta(
+    plan: WindowPlan,
+    src: np.ndarray,
+    dst: np.ndarray,
+    w: np.ndarray,
+    *,
+    n: int,
+    rows: np.ndarray,
+    fingerprint: str,
+) -> WindowPlan | None:
+    """Fold per-epoch churn into a cached plan: replace the out-edges of
+    the hinted ``rows`` (every source peer whose attestation changed
+    since the plan's graph — row normalization rewrites exactly those
+    rows) with their slice of the new normalized edge list
+    ``(src, dst, w)``.  Returns the delta-updated plan, or None when the
+    delta cannot be applied (overflow, shrink), when it would not pay
+    (churn spread over too many windows — past the measured crossover
+    a full rebuild's vectorized counting sorts beat the per-window
+    repack, PERF.md §11), or when it fails the edge-count tripwire (a
+    stale/incomplete ``rows`` hint would stamp the new fingerprint
+    onto a layout that doesn't encode the new graph — in that case the
+    caller must rebuild).
+    """
+    rows = np.unique(np.asarray(rows, np.int64))
+    if rows.size == 0:
+        return None
+    # Delta-vs-rebuild crossover: the repack loop costs ~constant per
+    # touched window while the rebuild is one vectorized O(E) pass, so
+    # window-spread churn (every window touched) runs ~5x SLOWER as a
+    # delta.  The measured crossover sits near a quarter of the data
+    # windows; the 64-window floor keeps small graphs (few windows
+    # total, trivially all touched) on the delta path where the
+    # absolute cost is noise.
+    data_windows = max(1, int(np.count_nonzero(np.diff(plan.row_offset))))
+    touched_windows = int(np.unique(rows >> _WIN_BITS).size)
+    if touched_windows > max(64, data_windows // 4):
+        return None
+    mask = np.isin(src, rows.astype(src.dtype))
+    try:
+        new_plan = plan.replace_rows(
+            rows, src[mask], dst[mask], w[mask], n=n, fingerprint=fingerprint
+        )
+    except PlanDeltaError:
+        return None
+    if new_plan.n_edges != src.shape[0]:
+        # The hint missed a changed row: the delta edge count disagrees
+        # with the target graph.  Never serve a mislabeled layout.
+        return None
+    return new_plan
 
 
 def bridge_partials(

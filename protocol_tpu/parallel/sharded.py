@@ -66,6 +66,7 @@ from ..ops.gather_window import (
     _counting_sort,
     build_window_plan,
     graph_fingerprint,
+    try_plan_delta,
     windowed_ct,
 )
 from ..trust.graph import TrustGraph
@@ -225,6 +226,7 @@ class ShardedWindowPlan:
     p: jax.Array  # (n,) f32, replicated
     dangling: jax.Array  # (n,) f32, replicated
     plan: WindowPlan  # the single-graph plan this partitions
+    plan_outcome: str  # how the plan was resolved: reuse | delta | rebuild
 
     @classmethod
     def build(
@@ -233,21 +235,33 @@ class ShardedWindowPlan:
         mesh: Mesh,
         *,
         plan: WindowPlan | None = None,
+        delta_rows: np.ndarray | None = None,
         interpret: bool | None = None,
     ) -> "ShardedWindowPlan":
         """Normalize the graph, reuse (or build) its ``WindowPlan``, and
         partition it across the mesh.  A candidate ``plan`` (e.g.
         checkpoint-restored) is revalidated by fingerprint and layout
-        version, exactly like the single-device backend."""
+        version, exactly like the single-device backend; on a
+        fingerprint miss with a ``delta_rows`` churn hint the plan is
+        delta-updated (``WindowPlan.apply_delta``) instead of rebuilt,
+        and the partition is recut from the updated plan — the
+        ``plan_outcome`` field reports which path ran."""
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         fp = graph_fingerprint(g.n, g.src, g.dst, w)
-        if (
-            plan is None
-            or getattr(plan, "version", 0) != PLAN_VERSION
-            or plan.fingerprint != fp
-        ):
-            plan = build_window_plan(g.src, g.dst, w, n=g.n)
+        outcome = "reuse"
+        valid = plan is not None and getattr(plan, "version", 0) == PLAN_VERSION
+        if not (valid and plan.fingerprint == fp):
+            delta = None
+            if valid and delta_rows is not None:
+                delta = try_plan_delta(
+                    plan, g.src, g.dst, w, n=g.n, rows=delta_rows, fingerprint=fp
+                )
+            if delta is not None:
+                plan, outcome = delta, "delta"
+            else:
+                plan = build_window_plan(g.src, g.dst, w, n=g.n)
+                outcome = "rebuild"
 
         n_shards = mesh.shape[SHARD_AXIS]
         rows_per_shard = -(-plan.n_rows // (n_shards * BLOCK_ROWS)) * BLOCK_ROWS
@@ -260,18 +274,20 @@ class ShardedWindowPlan:
         weight[: plan.n_rows * 8] = plan.weight
 
         # Segment table: bucket order is slot order, so the row cuts
-        # give contiguous per-shard slices.
-        s = plan.n_segments
-        shard_of = (plan.seg_end // ROW) // rows_per_shard
+        # give contiguous per-shard slices.  Only the plan's live runs
+        # partition — its device-capacity pads are regenerated here as
+        # per-shard padding.
+        live_end = plan.seg_end[: plan.n_segments]
+        live_first = plan.seg_first[: plan.n_segments]
+        shard_of = (live_end // ROW) // rows_per_shard
         counts = np.bincount(shard_of, minlength=n_shards)
         offsets = np.concatenate([[0], np.cumsum(counts)])
-        s_max = max(int(counts.max()), 1)
-        # Bucket-order run destinations, recovered from the stored dst
-        # permutation (the plan keeps no explicit per-run dst array).
-        seg_dst = np.empty(s, np.int32)
-        seg_dst[plan.seg_perm] = np.repeat(
-            np.arange(plan.n, dtype=np.int32), np.diff(plan.dst_ptr)
-        )
+        # Quantized per-shard run capacity: small per-epoch deltas keep
+        # the sharded array shapes (and the compiled runner) stable.
+        s_max = -(-max(int(counts.max()), 1) // 1024) * 1024
+        # Bucket-order run destinations: stored on the plan since
+        # layout v3 (the delta-update bookkeeping keeps it current).
+        seg_dst = plan.seg_dst
         seg_end = np.zeros((n_shards, s_max), np.int32)
         seg_first = np.ones((n_shards, s_max), bool)
         seg_perm = np.zeros((n_shards, s_max), np.int32)
@@ -279,8 +295,8 @@ class ShardedWindowPlan:
         for k in range(n_shards):
             beg, end = int(offsets[k]), int(offsets[k + 1])
             sk = end - beg
-            seg_end[k, :sk] = plan.seg_end[beg:end] - k * rows_per_shard * ROW
-            seg_first[k, :sk] = plan.seg_first[beg:end]
+            seg_end[k, :sk] = live_end[beg:end] - k * rows_per_shard * ROW
+            seg_first[k, :sk] = live_first[beg:end]
             # Pad runs stay a valid permutation so XLA's gather cost is
             # uniform; they land beyond dst_ptr[k, n] and are dropped.
             seg_perm[k, sk:] = np.arange(sk, s_max, dtype=np.int32)
@@ -311,6 +327,7 @@ class ShardedWindowPlan:
             p=jax.device_put(graph.pre_trust_vector(), repl),
             dangling=jax.device_put(dangling.astype(np.float32), repl),
             plan=plan,
+            plan_outcome=outcome,
         )
 
     def t0(self) -> jax.Array:
@@ -410,11 +427,14 @@ def converge_sharded(
     tol: float = 1e-6,
     max_iter: int = 50,
     record_residuals: bool = False,
+    t0: np.ndarray | None = None,
 ) -> tuple:
     """Damped power iteration to an L1 fixed point on the mesh, with
     the kernel selected by the problem type (``SHARDED_KERNELS``):
     ``ShardedTrustProblem`` runs the CSR/cumsum SpMV,
-    ``ShardedWindowPlan`` the fused windowed pipeline.
+    ``ShardedWindowPlan`` the fused windowed pipeline.  ``t0`` warm
+    starts the iteration (mesh-replicated like ``p``); None starts
+    from the pre-trust vector — the cold path.
 
     Returns ``(t, iterations, final residual)`` — plus the device-side
     per-iteration residual history as a fourth element when
@@ -432,6 +452,13 @@ def converge_sharded(
     alpha_dev = jax.device_put(
         np.float32(alpha), NamedSharding(problem.mesh, P())
     )
+    t0_dev = (
+        problem.t0()
+        if t0 is None
+        else jax.device_put(
+            np.asarray(t0, np.float32), NamedSharding(problem.mesh, P())
+        )
+    )
     if isinstance(problem, ShardedWindowPlan):
         run = _get_windowed_runner(
             problem.mesh,
@@ -448,7 +475,7 @@ def converge_sharded(
             problem.seg_first,
             problem.seg_perm,
             problem.dst_ptr,
-            problem.t0(),
+            t0_dev,
             problem.p,
             problem.dangling,
             alpha_dev,
@@ -462,7 +489,7 @@ def converge_sharded(
             problem.src,
             problem.w,
             problem.row_ptr,
-            problem.t0(),
+            t0_dev,
             problem.p,
             problem.dangling,
             alpha_dev,

@@ -45,9 +45,10 @@ from ..ops.gather_window import (
     build_window_plan,
     converge_windowed,
     graph_fingerprint,
+    try_plan_delta,
 )
 from ..obs import TRACER
-from ..obs.metrics import PLAN_REBUILDS, PLAN_REUSES
+from ..obs.metrics import PLAN_OUTCOMES, PLAN_REBUILDS, PLAN_REUSES
 from ..ops.sparse import converge_csr, converge_sparse
 from .graph import TrustGraph
 
@@ -82,6 +83,28 @@ def _history(hist, iterations: int) -> np.ndarray:
     return np.asarray(hist, dtype=np.float64)[: int(iterations)]
 
 
+def _initial_vector(t0, p: np.ndarray) -> np.ndarray:
+    """Resolve the iteration's starting vector: the caller's warm-start
+    ``t0`` (the previous epoch's fixed point, remapped over
+    joined/departed peers) L1-renormalized, or the pre-trust vector
+    ``p`` — the cold start — when ``t0`` is absent, mis-shaped, or
+    degenerate.  A near-fixed-point start is pure initial-carry data:
+    the step function, and therefore the pinned kernel budgets, are
+    untouched (PERF.md §11)."""
+    if t0 is None:
+        return p
+    t0 = np.asarray(t0, dtype=np.float32).reshape(-1)
+    if t0.shape != p.shape or not np.isfinite(t0).all():
+        return p
+    # Converged score vectors carry ±1-ulp negative dust on zero-score
+    # peers (compensated-sum differencing); clip rather than reject.
+    t0 = np.maximum(t0, 0.0)
+    s = float(t0.sum())
+    if not np.isfinite(s) or s <= 0:
+        return p
+    return t0 / np.float32(s)
+
+
 class TrustBackend:
     name = "abstract"
 
@@ -93,6 +116,7 @@ class TrustBackend:
         tol: float = 1e-6,
         max_iter: int = 50,
         record_residuals: bool = True,
+        t0: np.ndarray | None = None,
     ) -> ConvergenceResult:
         raise NotImplementedError
 
@@ -110,7 +134,7 @@ class NativeCPUBackend(TrustBackend):
     name = "native-cpu"
 
     def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
-                 record_residuals=True):
+                 record_residuals=True, t0=None):
         g = graph.drop_self_edges()
         dense = g.to_dense()
         n = g.n
@@ -134,7 +158,16 @@ class NativeCPUBackend(TrustBackend):
                 s = Fraction(row_sums[i])
                 rows.append([Fraction(dense[i][j]) / s for j in range(n)])
         a = Fraction(alpha).limit_denominator(10**9)
-        t = list(p)
+        # Warm start: rationalize the seed exactly like alpha; the
+        # fixed point is start-independent, only the path shortens.
+        pf = np.array([float(x) for x in p], dtype=np.float32)
+        start = _initial_vector(t0, pf)
+        if start is pf:
+            t = list(p)
+        else:
+            raw = [Fraction(float(x)).limit_denominator(10**12) for x in start]
+            s = sum(raw)
+            t = [x / s for x in raw] if s > 0 else list(p)
         it = 0
         resid = Fraction(0)
         history: list[float] = []
@@ -162,7 +195,7 @@ class DenseJaxBackend(TrustBackend):
     name = "tpu-dense"
 
     def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
-                 record_residuals=True):
+                 record_residuals=True, t0=None):
         g = graph.drop_self_edges()
         dense = g.to_dense().astype(np.float32)
         row_sums = dense.sum(axis=1)
@@ -170,7 +203,7 @@ class DenseJaxBackend(TrustBackend):
         dangling = row_sums <= 0
         norm = np.where(dangling[:, None], p[None, :], dense / np.where(dangling, 1.0, row_sums)[:, None])
         m = (1.0 - alpha) * norm.T + alpha * np.outer(p, np.ones(g.n, np.float32))
-        t = jnp.asarray(p)
+        t = jnp.asarray(_initial_vector(t0, p))
         m = jnp.asarray(m.astype(np.float32))
         it = 0
         resid = np.inf
@@ -204,7 +237,7 @@ class SparseJaxBackend(TrustBackend):
     name = "tpu-sparse"
 
     def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
-                 record_residuals=True):
+                 record_residuals=True, t0=None):
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         g = TrustGraph(g.n, g.src, g.dst, w, graph.pre_trusted).sorted_by_dst()
@@ -214,7 +247,7 @@ class SparseJaxBackend(TrustBackend):
                 jnp.asarray(g.src),
                 jnp.asarray(g.dst),
                 jnp.asarray(g.weight),
-                jnp.asarray(p),
+                jnp.asarray(_initial_vector(t0, p)),
                 jnp.asarray(p),
                 jnp.asarray(dangling.astype(np.float32)),
                 n=g.n,
@@ -240,7 +273,7 @@ class CsrJaxBackend(TrustBackend):
     name = "tpu-csr"
 
     def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
-                 record_residuals=True):
+                 record_residuals=True, t0=None):
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         g = TrustGraph(g.n, g.src, g.dst, w, graph.pre_trusted).sorted_by_dst()
@@ -250,7 +283,7 @@ class CsrJaxBackend(TrustBackend):
                 jnp.asarray(g.src),
                 jnp.asarray(g.row_ptr_by_dst()),
                 jnp.asarray(g.weight),
-                jnp.asarray(p),
+                jnp.asarray(_initial_vector(t0, p)),
                 jnp.asarray(p),
                 jnp.asarray(dangling.astype(np.float32)),
                 alpha=jax.device_put(np.float32(alpha)),
@@ -277,7 +310,10 @@ class WindowedJaxBackend(TrustBackend):
     The one-time ``WindowPlan`` (host bucketing + reduction layout) is
     cached on the instance and revalidated by graph fingerprint, so
     repeated epochs over a stable graph — and reboots that restore the
-    plan from a checkpoint — skip construction entirely.
+    plan from a checkpoint — skip construction entirely.  On a
+    fingerprint miss with a churn hint (``delta_rows``: the source
+    peers whose out-edges changed since the cached plan's graph), the
+    plan is delta-updated in place of a full rebuild (PERF.md §11).
     """
 
     name = "tpu-windowed"
@@ -291,29 +327,50 @@ class WindowedJaxBackend(TrustBackend):
         self.interpret = interpret
         #: The plan the last converge actually used (for persistence).
         self.last_plan: WindowPlan | None = plan
+        #: Churn hint for the NEXT converge: ids of every source peer
+        #: whose out-edges changed since ``plan``'s graph (a superset is
+        #: fine).  Consumed (reset to None) by the converge; when the
+        #: fingerprint misses and the hint is present, the plan is
+        #: delta-updated instead of rebuilt.
+        self.delta_rows: np.ndarray | None = None
+
+    def _resolve_plan(self, g, w, fp: str) -> WindowPlan:
+        """Reuse, delta-update, or rebuild the cached plan for the
+        normalized graph; counts the outcome on the plan metrics.
+        Delta application is host-side, strictly before any device
+        dispatch (graftlint's plan-mutation-in-converge rule pins the
+        converse)."""
+        plan, rows = self.plan, self.delta_rows
+        self.delta_rows = None
+        valid = plan is not None and getattr(plan, "version", 0) == PLAN_VERSION
+        if valid and plan.fingerprint == fp:
+            PLAN_REUSES.inc()
+            PLAN_OUTCOMES.inc(outcome="reuse")
+            return plan
+        if valid and rows is not None:
+            with TRACER.span("plan", backend=self.name, reason="delta"):
+                delta = try_plan_delta(
+                    plan, g.src, g.dst, w, n=g.n, rows=rows, fingerprint=fp
+                )
+            if delta is not None:
+                PLAN_OUTCOMES.inc(outcome="delta")
+                return delta
+        reason = "cold" if plan is None else (
+            "stale-layout" if not valid else "fingerprint-miss"
+        )
+        with TRACER.span("plan", backend=self.name, reason=reason):
+            plan = build_window_plan(g.src, g.dst, w, n=g.n)
+        PLAN_REBUILDS.inc()
+        PLAN_OUTCOMES.inc(outcome="rebuild")
+        return plan
 
     def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
-                 record_residuals=True):
+                 record_residuals=True, t0=None):
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         fp = graph_fingerprint(g.n, g.src, g.dst, w)
-        plan = self.plan
-        if (
-            plan is None
-            or getattr(plan, "version", 0) != PLAN_VERSION
-            or plan.fingerprint != fp
-        ):
-            reason = "cold" if plan is None else (
-                "stale-layout"
-                if getattr(plan, "version", 0) != PLAN_VERSION
-                else "fingerprint-miss"
-            )
-            with TRACER.span("plan", backend=self.name, reason=reason):
-                plan = build_window_plan(g.src, g.dst, w, n=g.n)
-            PLAN_REBUILDS.inc()
-            self.plan = plan
-        else:
-            PLAN_REUSES.inc()
+        plan = self._resolve_plan(g, w, fp)
+        self.plan = plan
         self.last_plan = plan
         p = graph.pre_trust_vector()
         interpret = (
@@ -324,7 +381,7 @@ class WindowedJaxBackend(TrustBackend):
         with TRACER.span("converge", backend=self.name):
             out = converge_windowed(
                 *plan.device_args(),
-                jnp.asarray(p),
+                jnp.asarray(_initial_vector(t0, p)),
                 jnp.asarray(p),
                 jnp.asarray(dangling.astype(np.float32)),
                 n_rows=plan.n_rows,
@@ -369,9 +426,12 @@ class ShardedJaxBackend(TrustBackend):
         self.plan: WindowPlan | None = None
         #: The plan the last converge actually used (for persistence).
         self.last_plan: WindowPlan | None = None
+        #: Churn hint consumed by the next converge — same contract as
+        #: ``WindowedJaxBackend.delta_rows``.
+        self.delta_rows: np.ndarray | None = None
 
     def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
-                 record_residuals=True):
+                 record_residuals=True, t0=None):
         from ..parallel.mesh import default_mesh
         from ..parallel.sharded import (
             ShardedTrustProblem,
@@ -385,18 +445,28 @@ class ShardedJaxBackend(TrustBackend):
         )
         problem: ShardedTrustProblem | ShardedWindowPlan
         if self.kernel == "tpu-windowed":
-            candidate = self.plan
+            candidate, rows = self.plan, self.delta_rows
+            self.delta_rows = None
             with TRACER.span("plan", backend=name):
-                swp = ShardedWindowPlan.build(graph, mesh, plan=candidate)
-            (PLAN_REUSES if swp.plan is candidate else PLAN_REBUILDS).inc()
+                swp = ShardedWindowPlan.build(
+                    graph, mesh, plan=candidate, delta_rows=rows
+                )
+            if swp.plan_outcome == "reuse":
+                PLAN_REUSES.inc()
+            elif swp.plan_outcome == "rebuild":
+                PLAN_REBUILDS.inc()
+            PLAN_OUTCOMES.inc(outcome=swp.plan_outcome)
             self.plan = self.last_plan = swp.plan
             problem = swp
         else:
             problem = ShardedTrustProblem.build(graph, mesh)
+        start = (
+            None if t0 is None else _initial_vector(t0, graph.pre_trust_vector())
+        )
         with TRACER.span("converge", backend=name):
             out = converge_sharded(
                 problem, alpha=alpha, tol=tol, max_iter=max_iter,
-                record_residuals=record_residuals,
+                record_residuals=record_residuals, t0=start,
             )
         t, it, resid = out[:3]
         return ConvergenceResult(

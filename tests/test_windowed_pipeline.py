@@ -217,7 +217,9 @@ class TestSinglePassBoundary:
         # The shared recursive walker (protocol_tpu.analysis.jaxpr_walk)
         # — the analyzer gate counts gathers with exactly this traversal.
         gathers = collect_gathers(jaxpr.jaxpr)
-        s = plan.n_segments
+        # Device segment tables run at padded capacity (>= n_segments
+        # live runs) so per-epoch deltas keep the compiled shape.
+        s = plan.seg_capacity
         assert s != plan.n + 1  # keep the rowsum gathers distinguishable
         seg_sized = [e for e in gathers if e.outvars[0].aval.shape[:1] == (s,)]
         random_seg = [
