@@ -17,12 +17,66 @@
 #include "constants.h"
 #include "zk_common.h"
 
+#include <atomic>
 #include <cassert>
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <vector>
 
 typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------------
+// Phase timer table (deep attribution).
+//
+// Every public entry point accumulates its wall-clock into one of a
+// fixed set of phases so the Python prover can attribute SNARK time to
+// the engine loops (msm / ntt / gate_eval / field_ops / srs) without
+// per-call ctypes overhead: the table is a handful of relaxed atomics,
+// read out once per prove via zk_phase_stats().  Timing wraps whole
+// extern-C calls on the calling thread (OpenMP workers inside a call
+// are covered by the caller's interval), so concurrent Python threads
+// accumulate independently and correctly.
+
+enum ZkPhase { PH_MSM = 0, PH_NTT, PH_GATE_EVAL, PH_FIELD_OPS, PH_SRS, PH_COUNT };
+
+static std::atomic<int64_t> g_phase_calls[PH_COUNT];
+static std::atomic<int64_t> g_phase_ns[PH_COUNT];
+
+struct PhaseTimer {
+    ZkPhase phase;
+    std::chrono::steady_clock::time_point t0;
+    explicit PhaseTimer(ZkPhase p) : phase(p), t0(std::chrono::steady_clock::now()) {}
+    ~PhaseTimer() {
+        int64_t ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                         std::chrono::steady_clock::now() - t0)
+                         .count();
+        g_phase_calls[phase].fetch_add(1, std::memory_order_relaxed);
+        g_phase_ns[phase].fetch_add(ns, std::memory_order_relaxed);
+    }
+};
+
+extern "C" {
+
+int64_t zk_phase_count() { return PH_COUNT; }
+
+// out: PH_COUNT x 2 int64 (calls, nanoseconds), phase-enum order
+// (msm, ntt, gate_eval, field_ops, srs).
+void zk_phase_stats(int64_t *out) {
+    for (int p = 0; p < PH_COUNT; ++p) {
+        out[2 * p] = g_phase_calls[p].load(std::memory_order_relaxed);
+        out[2 * p + 1] = g_phase_ns[p].load(std::memory_order_relaxed);
+    }
+}
+
+void zk_phase_reset() {
+    for (int p = 0; p < PH_COUNT; ++p) {
+        g_phase_calls[p].store(0, std::memory_order_relaxed);
+        g_phase_ns[p].store(0, std::memory_order_relaxed);
+    }
+}
+
+}  // extern "C"
 
 // ---------------------------------------------------------------------
 // Generic 4-limb Montgomery field.
@@ -206,7 +260,7 @@ static void bit_reverse_permute(FrF *data, int64_t n) {
 
 extern "C" {
 
-int64_t zk_abi_version() { return 3; }
+int64_t zk_abi_version() { return 4; }
 
 // AVX-512IFMA engine (zk_ifma.cpp), dispatched at runtime.
 extern "C" {
@@ -230,6 +284,7 @@ static inline bool use_ifma() {
 // a primitive n-th root of unity (pass the inverse root for the inverse
 // transform; inverse=1 additionally scales by n^-1).
 void zk_ntt(uint64_t *data, int64_t n, const uint64_t *root_canon, int inverse) {
+    PhaseTimer _pt((PH_NTT));
 #if defined(__x86_64__)
     if (use_ifma() && n >= 16) {
         ifma_ntt(data, n, root_canon, inverse);
@@ -280,6 +335,7 @@ void zk_ntt(uint64_t *data, int64_t n, const uint64_t *root_canon, int inverse) 
 }
 
 void zk_vec_mul(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) {
+    PhaseTimer _pt((PH_FIELD_OPS));
 #if defined(__x86_64__)
     if (use_ifma() && n >= 8) {
         int64_t head = n & ~7LL;
@@ -303,6 +359,7 @@ void zk_vec_mul(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) 
 
 // out[i] = base^i (canonical limbs) for i in [0, n).
 void zk_powers(const uint64_t *base_canon, int64_t n, uint64_t *out) {
+    PhaseTimer _pt((PH_FIELD_OPS));
     FrF base, acc;
     FrF::to_mont(base, base_canon);
     FrF::set_one(acc);
@@ -316,6 +373,7 @@ void zk_powers(const uint64_t *base_canon, int64_t n, uint64_t *out) {
 // acc/p are canonical; the product is computed in Montgomery form and
 // converted back before the canonical add.
 void zk_scale_add(uint64_t *acc, const uint64_t *p, const uint64_t *s_canon, int64_t n) {
+    PhaseTimer _pt((PH_FIELD_OPS));
 #if defined(__x86_64__)
     if (use_ifma() && n >= 8) {
         int64_t head = n & ~7LL;
@@ -342,6 +400,7 @@ void zk_scale_add(uint64_t *acc, const uint64_t *p, const uint64_t *s_canon, int
 // Horner evaluation of an n-coefficient polynomial at x (all canonical).
 void zk_poly_eval(const uint64_t *coeffs, int64_t n, const uint64_t *x_canon,
                   uint64_t *out) {
+    PhaseTimer _pt((PH_FIELD_OPS));
     FrF x, acc;
     FrF::to_mont(x, x_canon);
     FrF::set_zero(acc);
@@ -358,6 +417,7 @@ void zk_poly_eval(const uint64_t *coeffs, int64_t n, const uint64_t *x_canon,
 // guarantees p(z) == y so the remainder vanishes.
 void zk_div_linear(const uint64_t *coeffs, int64_t n, const uint64_t *z_canon,
                    uint64_t *out) {
+    PhaseTimer _pt((PH_FIELD_OPS));
     FrF z, rem;
     FrF::to_mont(z, z_canon);
     FrF::set_zero(rem);
@@ -372,6 +432,7 @@ void zk_div_linear(const uint64_t *coeffs, int64_t n, const uint64_t *z_canon,
 }
 
 void zk_vec_add(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) {
+    PhaseTimer _pt((PH_FIELD_OPS));
 #pragma omp parallel for schedule(static) if (n >= 4096)
     for (int64_t i = 0; i < n; ++i) {
         // canonical add/sub don't need the Montgomery domain
@@ -384,6 +445,7 @@ void zk_vec_add(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) 
 }
 
 void zk_vec_sub(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) {
+    PhaseTimer _pt((PH_FIELD_OPS));
 #pragma omp parallel for schedule(static) if (n >= 4096)
     for (int64_t i = 0; i < n; ++i) {
         FrF x, y, z;
@@ -396,6 +458,7 @@ void zk_vec_sub(const uint64_t *a, const uint64_t *b, uint64_t *out, int64_t n) 
 
 // Batch modular inverse (Montgomery trick); zeros invert to zero.
 void zk_batch_inv(const uint64_t *a, uint64_t *out, int64_t n) {
+    PhaseTimer _pt((PH_FIELD_OPS));
     std::vector<FrF> vals(n), prefix(n);
     FrF acc;
     FrF::set_one(acc);
@@ -593,6 +656,7 @@ extern "C" {
 // small to amortize the inversion fall back to mixed adds into shadow
 // Jacobian buckets.
 void zk_msm(const uint64_t *scalars, const uint64_t *points, int64_t n, uint64_t *out) {
+    PhaseTimer _pt((PH_MSM));
     if (n == 0) {
         memset(out, 0, 64);
         return;
@@ -840,6 +904,7 @@ void zk_msm(const uint64_t *scalars, const uint64_t *points, int64_t n, uint64_t
 
 // SRS ladder: out[i] = tau^i * G1 for i < n (generator (1, 2)).
 void zk_srs_powers(const uint64_t *tau, int64_t n, uint64_t *out) {
+    PhaseTimer _pt((PH_SRS));
     // Scalar ladder in Fr.
     std::vector<FrF> scal(n);
     FrF t, acc;
@@ -943,6 +1008,7 @@ int64_t zk_eval_program(int64_t m, int64_t n_cols, const uint64_t *cols,
 int64_t zk_eval_program2(int64_t m, int64_t n_cols, const uint64_t *const *cols,
                          int64_t rot_stride, const int64_t *code, int64_t code_len,
                          const uint64_t *consts, int64_t n_consts, uint64_t *out) {
+    PhaseTimer _pt((PH_GATE_EVAL));
     if (zk_validate_program(n_cols, code, code_len, n_consts) != 1) return -1;
 #if defined(__x86_64__)
     if (use_ifma() && m % 8 == 0 && rot_stride % 8 == 0) {

@@ -50,6 +50,18 @@ Pass 4 — the epoch-pipeline boundary rule (ISSUE 5):
   step — never from the device-facing converge path, where it would
   trace host arrays into the kernel (or silently run once at trace
   time and serve a stale layout forever after).
+
+Pass 5 — the flight-recorder boundary rule (ISSUE 6):
+
+- ``journal-write-in-jit`` (error): a flight-recorder write
+  (``JOURNAL.record``/``dump``/``flush`` or any
+  ``record``/``dump``/``flush`` on a journal-named receiver) inside a
+  jit- or shard_map-traced function.  Under a trace the event is
+  recorded once at trace time and never again — the journal would
+  "replay" a single stale event forever — and a callback-shaped
+  rewrite would smuggle a host sync into the hot loop.  Journal
+  writes happen at host boundaries (epoch tick, ingest, pipeline),
+  exactly like spans and metrics.
 """
 
 from __future__ import annotations
@@ -198,6 +210,24 @@ def _is_plan_mutation_call(name: str | None) -> bool:
     return name is not None and name.rsplit(".", 1)[-1] in _PLAN_MUTATION_METHODS
 
 
+#: Flight-recorder write entry points (pass 5).
+_JOURNAL_METHODS = frozenset({"record", "dump", "flush"})
+
+
+def _is_journal_call(name: str | None) -> bool:
+    """``JOURNAL.<write>(...)`` or ``<journalish>.<write>(...)`` where
+    the receiver names a journal/flight recorder — matching the method
+    leaf alone would catch unrelated ``.record()`` APIs, so the
+    receiver must look like the recorder."""
+    if name is None or "." not in name:
+        return False
+    receiver, leaf = name.rsplit(".", 1)
+    if leaf not in _JOURNAL_METHODS:
+        return False
+    tail = receiver.rsplit(".", 1)[-1].lower()
+    return "journal" in tail or "flight" in tail or tail == "recorder"
+
+
 def _is_span_call(name: str | None) -> bool:
     """obs span entry points (``TRACER.span``/``TRACER.epoch`` or any
     ``*.span(...)``) — host boundaries by definition, so inside a
@@ -301,6 +331,15 @@ class _Visitor(ast.NodeVisitor):
                     f"{name}() inside a traced function executes once "
                     "at trace time, not per call — log at the host "
                     "boundary instead",
+                    node,
+                )
+            elif _is_journal_call(name):
+                self._emit(
+                    "journal-write-in-jit",
+                    f"{name}() inside a traced function records once at "
+                    "trace time and never again — flight-recorder writes "
+                    "belong at host boundaries (epoch tick, ingest, "
+                    "pipeline), never in traced code",
                     node,
                 )
             elif _is_plan_mutation_call(name):

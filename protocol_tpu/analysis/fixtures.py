@@ -230,6 +230,25 @@ def _plan_mutation_in_converge() -> tuple[str, str]:
     return _PLAN_MUTATION_SRC, "protocol_tpu/trust/_fixture_plan_mutation.py"
 
 
+_JOURNAL_IN_JIT_SRC = '''\
+import jax
+
+from protocol_tpu.obs.journal import JOURNAL
+
+
+@jax.jit
+def step(t):
+    # Under a trace this records ONE event at trace time and never
+    # again — the flight recorder would replay a stale line forever.
+    JOURNAL.record("iteration", residual=t)  # VIOLATION: journal-write-in-jit
+    return t * 2.0
+'''
+
+
+def _journal_write_in_jit() -> tuple[str, str]:
+    return _JOURNAL_IN_JIT_SRC, "protocol_tpu/trust/_fixture_journal_in_jit.py"
+
+
 FIXTURES: dict[str, Fixture] = {
     f.name: f
     for f in (
@@ -265,6 +284,11 @@ FIXTURES: dict[str, Fixture] = {
         Fixture(
             "plan-mutation-in-converge", "plan-mutation-in-converge",
             _plan_mutation_in_converge, "plan-mutation-in-converge",
+            kind="ast",
+        ),
+        Fixture(
+            "journal-write-in-jit", "journal-write-in-jit",
+            _journal_write_in_jit, "journal-write-in-jit",
             kind="ast",
         ),
     )

@@ -50,6 +50,14 @@ class ProtocolConfig:
     #: default; span/metric telemetry is always on and costs no device
     #: sync either way.
     profile_dir: str | None = None
+    #: On-disk flight-recorder journal (obs/journal.py): a bounded
+    #: JSONL file every span close, ingest rejection, plan outcome,
+    #: coalesced tick, and anomaly is appended to by a batched writer
+    #: thread.  None keeps the recorder in-memory-only (the ring and
+    #: ``GET /debug/flight`` work either way); on crash/SIGTERM the
+    #: node dumps the ring next to this path (or to
+    #: ``FLIGHT_dump.jsonl`` in the working directory).
+    journal_path: str | None = None
 
     @property
     def host(self) -> str:
@@ -80,6 +88,7 @@ class ProtocolConfig:
         cfg.prover = obj.get("prover", cfg.prover)
         cfg.srs_path = obj.get("srs_path", cfg.srs_path)
         cfg.profile_dir = obj.get("profile_dir", cfg.profile_dir)
+        cfg.journal_path = obj.get("journal_path", cfg.journal_path)
         return cfg
 
     @classmethod

@@ -25,6 +25,8 @@ from ..crypto import calculate_message_hash, field
 from ..crypto.eddsa import PublicKey, sign, verify as verify_sig
 from ..obs import TRACER
 from ..obs import metrics as obs_metrics
+from ..obs.journal import JOURNAL
+from ..obs.watchers import DRIFT, RECOMPILES
 from ..ops.gather_window import WindowPlan
 from ..trust.backend import ConvergenceResult, get_backend
 from ..trust.graph import TrustGraph
@@ -227,11 +229,13 @@ class Manager:
         error = self._structural_error(att)
         if error is not None:
             obs_metrics.ATTESTATIONS_REJECTED.inc(reason=error[0])
+            JOURNAL.record("ingest-reject", reason=error[0])
             raise EigenError.invalid_attestation(error[1])
 
         _, message_hashes = calculate_message_hash(att.neighbours, [att.scores])
         if not self._verify_sig(att, message_hashes[0]):
             obs_metrics.ATTESTATIONS_REJECTED.inc(reason="bad-signature")
+            JOURNAL.record("ingest-reject", reason="bad-signature")
             raise EigenError.invalid_attestation("signature verification failed")
 
         obs_metrics.ATTESTATIONS_ACCEPTED.inc()
@@ -286,6 +290,7 @@ class Manager:
                 else:
                     results[i] = IngestResult(False, error[0])
                     obs_metrics.ATTESTATIONS_REJECTED.inc(reason=error[0])
+                    JOURNAL.record("ingest-reject", reason=error[0])
 
             t0 = time.perf_counter()
             if candidates and cnative.available():
@@ -313,6 +318,7 @@ class Manager:
                 else:
                     results[i] = IngestResult(False, "bad-signature")
                     obs_metrics.ATTESTATIONS_REJECTED.inc(reason="bad-signature")
+                    JOURNAL.record("ingest-reject", reason="bad-signature")
         return [r for r in results if r is not None]
 
     def get_attestation(self, pk: PublicKey) -> Attestation:
@@ -496,10 +502,21 @@ class Manager:
                 "its kernel access pattern is not lint-gated (PERF.md §9)",
                 self.config.backend,
             )
+        # Recompile watch: PR 5 guarantees a steady-state delta epoch
+        # (warm seed + delta-updated plan) keeps device shapes stable,
+        # so the jit cache must not miss across this converge.  The
+        # bracket reads _cache_size() at the host boundary only.
+        steady_state = prepared.t0 is not None and prepared.delta_rows is not None
+        jit_snapshot = RECOMPILES.snapshot()
         with self._plan_cache(backend, prepared.delta_rows):
             result = backend.converge(
                 graph, alpha=alpha, tol=tol, max_iter=max_iter, t0=prepared.t0
             )
+        RECOMPILES.observe(
+            jit_snapshot,
+            steady_state=steady_state,
+            epoch=prepared.epoch.number,
+        )
         if prepared.t0 is not None:
             obs_metrics.WARM_START_APPLIED.inc()
         # The epoch landed: its churn is folded into the cached plan
@@ -518,6 +535,16 @@ class Manager:
         if result.residuals is not None:
             for r in result.residuals:
                 obs_metrics.CONVERGENCE_RESIDUAL.observe(float(r))
+        # Score-integrity monitor: fixed-point drift vs the previous
+        # epoch (aligned by peer hash), top movers, and the stall
+        # detector over the residual trajectory — the /scores/drift
+        # surface.
+        DRIFT.observe(
+            prepared.epoch.number,
+            prepared.id_order,
+            result.scores,
+            result.residuals,
+        )
         return result
 
     def converge_epoch(

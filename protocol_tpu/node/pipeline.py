@@ -34,6 +34,7 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from ..obs import metrics as obs_metrics
+from ..obs.journal import JOURNAL
 from ..trust.backend import ConvergenceResult
 from .epoch import Epoch
 from .manager import Manager, PreparedEpoch
@@ -154,6 +155,11 @@ class EpochPipeline:
         if superseded is not None:
             self.coalesced += 1
             obs_metrics.EPOCH_TICKS_COALESCED.inc()
+            JOURNAL.record(
+                "coalesced-tick",
+                superseded=superseded.epoch.number,
+                by=prepared.epoch.number,
+            )
             log.warning(
                 "epoch %s superseded by %s before reaching the device "
                 "(pipeline backpressure)",
@@ -186,6 +192,12 @@ class EpochPipeline:
                 outcome = EpochOutcome(prepared.epoch, self._device_stage(prepared))
             except BaseException as exc:  # noqa: BLE001 - tick must not kill the loop
                 log.error("epoch %s device stage failed: %r", prepared.epoch, exc)
+                JOURNAL.record(
+                    "anomaly",
+                    what="epoch-device-stage-failed",
+                    epoch=prepared.epoch.number,
+                    error=repr(exc),
+                )
                 outcome = EpochOutcome(prepared.epoch, None, exc)
             with self._cv:
                 self.outcomes[prepared.epoch.number] = outcome

@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 
 import json
 
-from ..obs import TRACER, configure_logging, prometheus_text
+from ..obs import DRIFT, JOURNAL, TRACER, configure_logging, prometheus_text
 from ..obs import metrics as obs_metrics
 from ..obs.export import PROMETHEUS_CONTENT_TYPE, profile_session
 from ..utils.telemetry import TELEMETRY
@@ -102,6 +102,25 @@ def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
         # content type to text/plain for this path.  Never touches
         # device state — purely the host-side registry snapshot.
         return 200, prometheus_text()
+    if method == "GET" and path == "/scores/drift":
+        # Score-integrity surface (obs/watchers.py): L1/L∞ drift of
+        # the last landed fixed point vs its predecessor, top movers,
+        # and the residual-stall flag.  Empty object before the first
+        # converged epoch.
+        return 200, json.dumps(DRIFT.last())
+    if method == "GET" and path.split("?", 1)[0] == "/debug/flight":
+        # Flight-recorder tail: /debug/flight?n=200 (default: the full
+        # in-memory ring) as a JSONL body, newest last — the same
+        # format the crash dump writes, so tooling reads both.
+        from urllib.parse import parse_qs, urlsplit
+
+        try:
+            qs = parse_qs(urlsplit(path).query)
+            n = int(qs.get("n", ["-1"])[0])
+        except ValueError:
+            return BAD_REQUEST, "InvalidQuery"
+        events = JOURNAL.tail(None if n < 0 else n)
+        return 200, "".join(json.dumps(e) + "\n" for e in events)
     if method == "GET" and path.startswith("/trace/"):
         # /trace/<epoch> (or /trace/latest): the epoch's span tree as
         # nested JSON (epoch_tick → prove/build_graph/plan/converge/
@@ -350,6 +369,10 @@ class Node:
                     log.info("epoch %s: proof cached", epoch)
             except Exception as e:
                 log.error("epoch %s: %r", epoch, e)
+                JOURNAL.record(
+                    "anomaly", what="epoch-tick-failed", epoch=epoch.number,
+                    error=repr(e),
+                )
 
     def _event_source(self):
         if self.config.event_fixture:
@@ -412,7 +435,41 @@ class Node:
             else "",
         )
 
+    def _flight_dump_path(self) -> str:
+        """Where the flight-recorder ring lands on crash/SIGTERM."""
+        if self.config.journal_path:
+            return str(self.config.journal_path) + ".dump"
+        return "FLIGHT_dump.jsonl"
+
+    def dump_flight_recorder(self, reason: str) -> None:
+        """Persist the flight-recorder ring for a post-mortem; never
+        raises (this runs on the way down)."""
+        try:
+            path = JOURNAL.dump(self._flight_dump_path(), reason=reason)
+            log.warning("flight recorder dumped to %s (%s)", path, reason)
+        except Exception:  # noqa: BLE001 - dying anyway; don't mask the cause
+            log.exception("flight recorder dump failed")
+
     async def start(self) -> None:
+        if self.config.journal_path:
+            JOURNAL.configure(self.config.journal_path)
+        # SIGTERM post-mortem: dump the event ring before the process
+        # dies, so "what was the node doing" survives an orchestrator
+        # kill.  Best-effort — platforms without add_signal_handler
+        # (or non-main-thread loops) skip it.
+        try:
+            import signal
+
+            loop = asyncio.get_running_loop()
+            loop.add_signal_handler(
+                signal.SIGTERM,
+                lambda: (
+                    self.dump_flight_recorder("SIGTERM"),
+                    loop.call_soon(asyncio.ensure_future, self.stop()),
+                ),
+            )
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
         if self.config.checkpoint_dir:
             self._restore_checkpoint()
         self.manager.generate_initial_attestations()
@@ -451,6 +508,9 @@ class Node:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+        # Flush the journal's pending batch so the on-disk JSONL is
+        # complete through the stop (the ring itself stays queryable).
+        JOURNAL.flush()
 
     async def run_forever(self) -> None:
         await self.start()
@@ -469,7 +529,15 @@ def main(argv=None) -> None:
     # the current epoch/span ids either way.
     configure_logging(level=logging.INFO)
     config = ProtocolConfig.load(args.config)
-    asyncio.run(Node.from_config(config).run_forever())
+    node = Node.from_config(config)
+    try:
+        asyncio.run(node.run_forever())
+    except (Exception, KeyboardInterrupt):
+        # Crash post-mortem: the last thing the process does is
+        # persist the flight-recorder ring, then re-raise so the exit
+        # code and traceback are unchanged.
+        node.dump_flight_recorder("crash")
+        raise
 
 
 if __name__ == "__main__":

@@ -1,28 +1,42 @@
-"""Node-wide observability: trace spans, metrics, exporters.
+"""Node-wide observability: trace spans, metrics, exporters, the
+flight recorder, and runtime invariant watchers.
 
 The structured successor of the bare ``utils.telemetry`` timers: one
 coherent instrumentation layer threaded through ingest, convergence,
-proving, checkpointing, and serving.  Three pieces:
+proving, checkpointing, and serving.  Five pieces:
 
 - :mod:`~protocol_tpu.obs.trace` — hierarchical spans (context
   managers, monotonic timing, contextvar nesting) collected into a
   per-epoch span tree the node serves as ``GET /trace/<epoch>``;
+  ``Tracer.attach_closed`` bridges out-of-band attributions (the
+  native prover's phase-timer table) into the same tree;
 - :mod:`~protocol_tpu.obs.metrics` — a thread-safe registry of
   counters/gauges/histograms (ingest accept/reject by reason,
   sig-verify throughput, iterations-to-convergence, the per-iteration
   residual trajectory, dropped epoch ticks, checkpoint and
-  window-plan events) served as ``GET /metrics``;
+  window-plan events, jit recompiles, score drift, journal volume)
+  served as ``GET /metrics``;
 - :mod:`~protocol_tpu.obs.export` — Prometheus text + JSON renderers
-  and the opt-in ``jax.profiler`` session hook.
+  and the opt-in ``jax.profiler`` session hook;
+- :mod:`~protocol_tpu.obs.journal` — the flight recorder: a bounded
+  JSONL event journal (ring + batched writer) every closed span,
+  ingest rejection, plan outcome, coalesced tick, and anomaly writes
+  through; served as ``GET /debug/flight``, dumped on crash/SIGTERM;
+- :mod:`~protocol_tpu.obs.watchers` — runtime invariant watchers:
+  jit recompile tracking around the converge entry points, per-span
+  device-memory watermarks, and the score-integrity/drift monitor
+  behind ``GET /scores/drift``.
 
-Doctrine (enforced by graftlint pass 3, ``analysis/ast_rules.py``):
-spans and metrics live at *host boundaries only*.  Nothing here may be
-called from inside a jit-traced function, and the per-iteration
-residual trajectory is captured device-side in the ``lax.while_loop``
-carry (``ops.sparse.run_power_iteration``) and fetched ONCE after
+Doctrine (enforced by graftlint passes 3 and 5,
+``analysis/ast_rules.py``): spans, metrics, and journal writes live
+at *host boundaries only*.  Nothing here may be called from inside a
+jit-traced function, and the per-iteration residual trajectory is
+captured device-side in the ``lax.while_loop`` carry
+(``ops.sparse.run_power_iteration``) and fetched ONCE after
 convergence — the hot loop never syncs, logs, or reads a clock.
 
-This package imports only the standard library, so instrumenting a
+This package imports only the standard library at import time (the
+watchers reach jax lazily, inside method calls), so instrumenting a
 module costs nothing at import time.
 """
 
@@ -30,6 +44,7 @@ from __future__ import annotations
 
 from . import metrics as _metrics
 from .export import metrics_json, profile_session, prometheus_text
+from .journal import JOURNAL, FlightRecorder
 from .metrics import METRICS, MetricsRegistry
 from .trace import (
     TRACER,
@@ -38,17 +53,49 @@ from .trace import (
     Tracer,
     configure_logging,
 )
-
-# Every closed span feeds the phase-seconds histogram, so span timings
-# (plan, converge, prove, checkpoint, sig_verify, ...) are scrapeable
-# without separate timer plumbing at each site.
-TRACER.on_span_close = lambda span: _metrics.PHASE_SECONDS.observe(
-    span.duration_s or 0.0, phase=span.name
+from .watchers import (
+    DRIFT,
+    MEMORY_WATERMARKS,
+    RECOMPILES,
+    MemoryWatermarkWatcher,
+    RecompileTracker,
+    ScoreDriftMonitor,
 )
 
+
+def _span_closed(span: Span) -> None:
+    # Memory watermark first so the delta lands in the span's attrs
+    # before the event is journaled.
+    MEMORY_WATERMARKS.on_close(span)
+    # Every closed span feeds the phase-seconds histogram, so span
+    # timings (plan, converge, prove, checkpoint, sig_verify, ...) are
+    # scrapeable without separate timer plumbing at each site.
+    _metrics.PHASE_SECONDS.observe(span.duration_s or 0.0, phase=span.name)
+    # ... and the flight recorder, so a post-mortem replays the span
+    # sequence without the trace ring having kept the epoch.
+    fields = {"name": span.name, "duration_s": round(span.duration_s or 0.0, 6)}
+    for k, v in span.attrs.items():
+        if k not in fields and k not in ("ts", "seq", "kind") and isinstance(
+            v, (str, int, float, bool)
+        ):
+            fields[k] = v
+    JOURNAL.record("span", **fields)
+
+
+TRACER.on_span_close = _span_closed
+TRACER.on_span_open = MEMORY_WATERMARKS.on_open
+
 __all__ = [
+    "DRIFT",
+    "JOURNAL",
     "METRICS",
+    "MEMORY_WATERMARKS",
+    "RECOMPILES",
+    "FlightRecorder",
+    "MemoryWatermarkWatcher",
     "MetricsRegistry",
+    "RecompileTracker",
+    "ScoreDriftMonitor",
     "Span",
     "SpanContextFilter",
     "TRACER",

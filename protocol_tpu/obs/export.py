@@ -19,7 +19,15 @@ from .metrics import METRICS, Histogram, Metric, MetricsRegistry
 
 
 def _escape_label_value(value: str) -> str:
+    """Exposition-format label-value escaping: backslash FIRST (or the
+    other escapes' backslashes double), then quote and newline."""
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    newline only (quotes are legal in help text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _labels(names: tuple[str, ...], values: tuple[str, ...], extra: str = "") -> str:
@@ -44,7 +52,7 @@ def _fmt(value: float) -> str:
 def _render_metric(metric: Metric) -> list[str]:
     lines = []
     if metric.help:
-        lines.append(f"# HELP {metric.name} {metric.help}")
+        lines.append(f"# HELP {metric.name} {_escape_help(metric.help)}")
     lines.append(f"# TYPE {metric.name} {metric.kind}")
     if isinstance(metric, Histogram):
         snap = metric.snapshot()

@@ -351,6 +351,44 @@ PHASE_SECONDS = METRICS.histogram(
     labelnames=("phase",),
     buckets=TIME_BUCKETS,
 )
+JIT_RECOMPILES = METRICS.counter(
+    "eigentrust_jit_recompiles_total",
+    "Compilation-cache misses of the jit'd converge/step entry points "
+    "by function — a steady-state delta epoch that recompiles broke "
+    "the stable-shape guarantee (PERF.md §11)",
+    labelnames=("fn",),
+)
+SCORE_DRIFT_L1 = METRICS.gauge(
+    "eigentrust_score_drift_l1",
+    "L1 distance between consecutive epochs' fixed points (surviving "
+    "peers aligned by hash)",
+)
+SCORE_DRIFT_LINF = METRICS.gauge(
+    "eigentrust_score_drift_linf",
+    "L-infinity distance between consecutive epochs' fixed points",
+)
+RESIDUAL_STALLS = METRICS.counter(
+    "eigentrust_residual_stalls_total",
+    "Epochs whose residual trajectory was non-monotone past the "
+    "stall threshold (convergence health anomaly)",
+)
+DEVICE_MEMORY_DELTA = METRICS.gauge(
+    "eigentrust_device_memory_delta_bytes",
+    "bytes_in_use growth across the last closed span, by phase "
+    "(memory_stats watermark watcher; absent on platforms without "
+    "allocator stats)",
+    labelnames=("phase",),
+)
+JOURNAL_EVENTS = METRICS.counter(
+    "eigentrust_journal_events_total",
+    "Flight-recorder events recorded, by kind",
+    labelnames=("kind",),
+)
+JOURNAL_DROPPED = METRICS.counter(
+    "eigentrust_journal_dropped_total",
+    "Flight-recorder events evicted from the bounded ring before "
+    "reaching disk (journal backpressure)",
+)
 
 __all__ = [
     "Counter",
@@ -381,4 +419,11 @@ __all__ = [
     "PIPELINE_QUEUE_DEPTH",
     "WARM_START_APPLIED",
     "PHASE_SECONDS",
+    "JIT_RECOMPILES",
+    "SCORE_DRIFT_L1",
+    "SCORE_DRIFT_LINF",
+    "RESIDUAL_STALLS",
+    "DEVICE_MEMORY_DELTA",
+    "JOURNAL_EVENTS",
+    "JOURNAL_DROPPED",
 ]

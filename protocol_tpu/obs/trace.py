@@ -100,6 +100,9 @@ class Tracer:
         #: Called with every closed span (package wiring feeds the
         #: phase-seconds histogram).  Must be cheap and never raise.
         self.on_span_close: Callable[[Span], None] | None = None
+        #: Called with every opened span (the device-memory watermark
+        #: watcher snapshots allocator state here).  Same contract.
+        self.on_span_open: Callable[[Span], None] | None = None
 
     # -- spans ----------------------------------------------------------
 
@@ -118,6 +121,12 @@ class Tracer:
         if parent is not None:
             parent.children.append(sp)
         token = _current_span.set(sp)
+        open_hook = self.on_span_open
+        if open_hook is not None:
+            try:
+                open_hook(sp)
+            except Exception:  # noqa: BLE001 - observability never throws
+                pass
         try:
             yield sp
         finally:
@@ -129,6 +138,40 @@ class Tracer:
                     hook(sp)
                 except Exception:  # noqa: BLE001 - observability never throws
                     pass
+
+    def attach_closed(self, name: str, duration_s: float, **attrs: Any) -> Span | None:
+        """Attach an already-measured phase as a closed child of the
+        current span — the bridge for sub-phase attributions gathered
+        out-of-band (the native prover's phase-timer table, accumulated
+        per-call timings) that have a total duration but no single
+        contiguous interval.  The synthetic span starts at attach time
+        minus its duration so ``start + duration`` never exceeds "now"
+        and ``end >= start`` always holds; it feeds ``on_span_close``
+        like a real span.  Returns None (and records nothing) when no
+        span is open — sub-phases without a parent have nowhere to
+        hang."""
+        parent = _current_span.get()
+        if parent is None:
+            return None
+        duration_s = max(float(duration_s), 0.0)
+        now = time.monotonic()
+        root_start = parent.start_monotonic - parent.start_offset_s
+        sp = Span(
+            name=name,
+            span_id=next(_span_ids),
+            attrs=attrs,
+            start_monotonic=now - duration_s,
+            start_offset_s=max(now - duration_s - root_start, 0.0),
+            duration_s=duration_s,
+        )
+        parent.children.append(sp)
+        hook = self.on_span_close
+        if hook is not None:
+            try:
+                hook(sp)
+            except Exception:  # noqa: BLE001 - observability never throws
+                pass
+        return sp
 
     @contextlib.contextmanager
     def epoch(self, epoch_number: int) -> Iterator[Span]:

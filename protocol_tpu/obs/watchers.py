@@ -1,0 +1,334 @@
+"""Runtime invariant watchers: recompiles, memory watermarks, drift.
+
+Three monitors that turn PR 5's *asserted* guarantees (stable shapes
+never recompile, warm starts land on the same fixed point) into
+*watched* ones on a running node:
+
+- :class:`RecompileTracker` — wraps compilation-cache-miss detection
+  around the jit'd converge entry points (``fn._cache_size()`` deltas,
+  observed at the host boundary around each epoch's converge).  Every
+  miss lands on ``eigentrust_jit_recompiles_total{fn}``; a miss during
+  a *steady-state delta epoch* (warm seed + delta plan, where PR 5
+  guarantees stable device shapes) is an anomaly: logged, journaled.
+- :class:`MemoryWatermarkWatcher` — per-span device-memory watermarks:
+  ``jax.local_devices()[*].memory_stats()`` snapshotted on span open,
+  delta recorded on span close (span attrs + a per-phase gauge).
+  Platforms without allocator stats (CPU) degrade to a no-op.
+- :class:`ScoreDriftMonitor` — score-integrity: per-epoch L1/L∞ drift
+  between consecutive fixed points (peers aligned by hash), the top-k
+  mover peers, and a residual-stall detector flagging non-monotone
+  convergence trajectories.  Served as ``GET /scores/drift`` and the
+  drift/stall gauges.
+
+This module imports only the standard library at import time (the obs
+doctrine); jax is reached lazily inside methods, and never from traced
+code — all observation happens at host boundaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Iterable
+
+from . import metrics as _metrics
+from .journal import JOURNAL
+
+log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Recompile tracker
+# ---------------------------------------------------------------------------
+
+
+class RecompileTracker:
+    """Compilation-cache-miss watcher over registered jit'd callables.
+
+    Jit entry points register once (at module import, next to their
+    definition or first construction); the epoch path then brackets
+    each converge with :meth:`snapshot` / :meth:`observe`, which diffs
+    ``fn._cache_size()`` — every increase is a fresh XLA compilation.
+    ``observe(steady_state=True)`` marks the bracket as a steady-state
+    delta epoch, where PR 5's stable-shape guarantee says the delta
+    must be zero; a miss there is warned and journaled as an anomaly.
+    """
+
+    def __init__(self) -> None:
+        self._fns: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, fn: Any) -> Any:
+        """Track ``fn`` (anything exposing ``_cache_size()``) under
+        ``name``; returns ``fn`` so call sites can register inline."""
+        if hasattr(fn, "_cache_size"):
+            with self._lock:
+                self._fns[name] = fn
+        return fn
+
+    def registered(self) -> list[str]:
+        with self._lock:
+            return sorted(self._fns)
+
+    def snapshot(self) -> dict[str, int]:
+        """Current per-function compilation-cache sizes."""
+        with self._lock:
+            fns = dict(self._fns)
+        sizes: dict[str, int] = {}
+        for name, fn in fns.items():
+            try:
+                sizes[name] = int(fn._cache_size())
+            except Exception:  # noqa: BLE001 - observability never throws
+                continue
+        return sizes
+
+    def observe(
+        self,
+        before: dict[str, int],
+        *,
+        steady_state: bool = False,
+        epoch: int | None = None,
+    ) -> dict[str, int]:
+        """Diff the cache sizes against ``before``: count misses on the
+        recompile metric, journal them, and (for a steady-state delta
+        epoch) warn — that epoch was guaranteed recompile-free.
+        Returns the per-function miss counts (empty = no recompiles)."""
+        after = self.snapshot()
+        misses = {
+            name: after[name] - before[name]
+            for name in after
+            if after[name] > before.get(name, after[name])
+        }
+        for name, count in misses.items():
+            _metrics.JIT_RECOMPILES.inc(count, fn=name)
+            JOURNAL.record(
+                "recompile",
+                fn=name,
+                count=count,
+                epoch=epoch,
+                steady_state=steady_state,
+            )
+        if misses and steady_state:
+            log.warning(
+                "steady-state delta epoch %s RECOMPILED (%s): the stable-shape "
+                "guarantee (PERF.md §11) did not hold — a delta plan changed "
+                "device shapes",
+                "?" if epoch is None else epoch,
+                ", ".join(f"{k}+{v}" for k, v in sorted(misses.items())),
+            )
+            JOURNAL.record(
+                "anomaly", what="steady-state-recompile", epoch=epoch,
+                fns=sorted(misses),
+            )
+        return misses
+
+
+#: Process-global tracker; jit'd converge entry points register here.
+RECOMPILES = RecompileTracker()
+
+
+# ---------------------------------------------------------------------------
+# Device-memory watermarks
+# ---------------------------------------------------------------------------
+
+
+class MemoryWatermarkWatcher:
+    """Per-span device-memory watermarks via ``memory_stats()``.
+
+    Installed as the tracer's ``on_span_open``/``on_span_close`` hook
+    pair: open snapshots ``bytes_in_use`` summed over local devices,
+    close records the delta (and the peak, where the allocator reports
+    one) into the span's attrs and the per-phase gauge.  The first call
+    probes whether the platform exposes allocator stats at all (CPU
+    returns None) and disables itself when it doesn't, so the steady
+    state on unsupported platforms is two no-op attribute reads."""
+
+    def __init__(self) -> None:
+        self._enabled: bool | None = None  # None = not probed yet
+
+    def _devices(self):
+        import jax
+
+        return jax.local_devices()
+
+    def _bytes_in_use(self) -> tuple[int, int] | None:
+        """(bytes_in_use, peak_bytes_in_use) summed over local devices,
+        or None when the platform has no allocator stats."""
+        try:
+            stats = [d.memory_stats() for d in self._devices()]
+        except Exception:  # noqa: BLE001 - observability never throws
+            return None
+        if not stats or any(s is None for s in stats):
+            return None
+        return (
+            sum(int(s.get("bytes_in_use", 0)) for s in stats),
+            sum(int(s.get("peak_bytes_in_use", 0)) for s in stats),
+        )
+
+    def on_open(self, span) -> None:
+        if self._enabled is False:
+            return
+        snap = self._bytes_in_use()
+        if snap is None:
+            self._enabled = False
+            return
+        self._enabled = True
+        span.attrs["_mem_open_bytes"] = snap[0]
+
+    def on_close(self, span) -> None:
+        if self._enabled is not True:
+            return
+        opened = span.attrs.pop("_mem_open_bytes", None)
+        if opened is None:
+            return
+        snap = self._bytes_in_use()
+        if snap is None:
+            return
+        delta = snap[0] - int(opened)
+        span.attrs["dev_mem_delta_bytes"] = delta
+        span.attrs["dev_mem_peak_bytes"] = snap[1]
+        _metrics.DEVICE_MEMORY_DELTA.set(delta, phase=span.name)
+
+
+#: Process-global watermark watcher (wired by obs/__init__).
+MEMORY_WATERMARKS = MemoryWatermarkWatcher()
+
+
+# ---------------------------------------------------------------------------
+# Score-integrity monitor
+# ---------------------------------------------------------------------------
+
+
+class ScoreDriftMonitor:
+    """Per-epoch fixed-point drift + convergence-health anomalies.
+
+    The manager feeds every landed epoch's ``(epoch, peer hashes,
+    scores, residual trajectory)``; the monitor aligns consecutive
+    fixed points by peer hash (joins/leaves drop out of the pairwise
+    drift), computes L1/L∞ drift and the top-k movers, and flags a
+    *residual stall* when the trajectory is non-monotone beyond
+    ``stall_tolerance`` (a residual that *rises* mid-convergence means
+    the operator or the seed changed under the iteration — exactly the
+    class of bug arXiv:2606.11956-style partial matvecs can introduce,
+    watched here before that work lands).  State is a scrape-ready
+    dict behind a lock (``GET /scores/drift``)."""
+
+    def __init__(self, top_k: int = 10, stall_tolerance: float = 1e-9):
+        self.top_k = int(top_k)
+        self.stall_tolerance = float(stall_tolerance)
+        self._lock = threading.Lock()
+        self._prev: tuple[list[int], Any] | None = None  # (hashes, scores)
+        self._last: dict[str, Any] = {}
+
+    def observe(
+        self,
+        epoch: int,
+        peer_hashes: Iterable[int],
+        scores,
+        residuals=None,
+    ) -> dict[str, Any]:
+        """Record one landed epoch; returns the drift summary dict."""
+        hashes = [int(h) for h in peer_hashes]
+        vals = [float(s) for s in scores]
+        summary: dict[str, Any] = {
+            "epoch": int(epoch),
+            "peers": len(hashes),
+            "l1": None,
+            "linf": None,
+            "joined": 0,
+            "departed": 0,
+            "top_movers": [],
+        }
+        with self._lock:
+            prev = self._prev
+            self._prev = (hashes, vals)
+        if prev is not None:
+            prev_by_hash = dict(zip(prev[0], prev[1]))
+            cur_set = set(hashes)
+            deltas: list[tuple[float, int, float]] = []
+            l1 = 0.0
+            linf = 0.0
+            for h, v in zip(hashes, vals):
+                old = prev_by_hash.get(h)
+                if old is None:
+                    summary["joined"] += 1
+                    continue
+                d = v - old
+                l1 += abs(d)
+                if abs(d) > linf:
+                    linf = abs(d)
+                deltas.append((abs(d), h, d))
+            summary["departed"] = sum(1 for h in prev[0] if h not in cur_set)
+            summary["l1"] = l1
+            summary["linf"] = linf
+            deltas.sort(reverse=True)
+            summary["top_movers"] = [
+                {"peer_hash": hex(h), "delta": d}
+                for absd, h, d in deltas[: self.top_k]
+                if absd > 0.0
+            ]
+            _metrics.SCORE_DRIFT_L1.set(l1)
+            _metrics.SCORE_DRIFT_LINF.set(linf)
+        stall = self._check_stall(residuals)
+        summary["residual_increases"] = stall[0]
+        summary["stalled"] = stall[1]
+        if stall[1]:
+            _metrics.RESIDUAL_STALLS.inc()
+            log.warning(
+                "epoch %d: non-monotone convergence — residual rose %d time(s) "
+                "beyond tolerance (trajectory stall)",
+                epoch,
+                stall[0],
+            )
+            JOURNAL.record(
+                "anomaly", what="residual-stall", epoch=int(epoch),
+                increases=stall[0],
+            )
+        JOURNAL.record(
+            "drift",
+            epoch=int(epoch),
+            l1=summary["l1"],
+            linf=summary["linf"],
+            joined=summary["joined"],
+            departed=summary["departed"],
+            stalled=summary["stalled"],
+        )
+        with self._lock:
+            self._last = summary
+        return summary
+
+    def _check_stall(self, residuals) -> tuple[int, bool]:
+        """(count of beyond-tolerance residual increases, stalled?).
+        One rise is tolerated (warm starts can overshoot on the first
+        step); two or more is a stall."""
+        if residuals is None:
+            return 0, False
+        vals = [float(r) for r in residuals]
+        increases = sum(
+            1 for a, b in zip(vals, vals[1:]) if b > a + self.stall_tolerance
+        )
+        return increases, increases >= 2
+
+    def last(self) -> dict[str, Any]:
+        """The newest drift summary (empty before the first epoch)."""
+        with self._lock:
+            return dict(self._last)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._prev = None
+            self._last = {}
+
+
+#: Process-global drift monitor (the node's /scores/drift source).
+DRIFT = ScoreDriftMonitor()
+
+
+__all__ = [
+    "DRIFT",
+    "MEMORY_WATERMARKS",
+    "RECOMPILES",
+    "MemoryWatermarkWatcher",
+    "RecompileTracker",
+    "ScoreDriftMonitor",
+]

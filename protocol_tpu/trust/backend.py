@@ -48,9 +48,23 @@ from ..ops.gather_window import (
     try_plan_delta,
 )
 from ..obs import TRACER
+from ..obs.journal import JOURNAL
 from ..obs.metrics import PLAN_OUTCOMES, PLAN_REBUILDS, PLAN_REUSES
+from ..obs.watchers import RECOMPILES
 from ..ops.sparse import converge_csr, converge_sparse
 from .graph import TrustGraph
+
+# Register the jit'd converge entry points with the recompile tracker
+# (obs/watchers.py): the epoch path brackets each converge with a
+# cache-size snapshot, so every fresh XLA compilation is counted on
+# eigentrust_jit_recompiles_total{fn} — and a steady-state delta epoch
+# that recompiles (breaking PR 5's stable-shape guarantee) is flagged.
+# Registration reads nothing from the device; it only keeps a
+# reference for later _cache_size() reads at host boundaries.
+RECOMPILES.register("converge_dense", converge_dense)
+RECOMPILES.register("converge_sparse", converge_sparse)
+RECOMPILES.register("converge_csr", converge_csr)
+RECOMPILES.register("converge_windowed", converge_windowed)
 
 
 @dataclass
@@ -346,6 +360,7 @@ class WindowedJaxBackend(TrustBackend):
         if valid and plan.fingerprint == fp:
             PLAN_REUSES.inc()
             PLAN_OUTCOMES.inc(outcome="reuse")
+            JOURNAL.record("plan", outcome="reuse", backend=self.name)
             return plan
         if valid and rows is not None:
             with TRACER.span("plan", backend=self.name, reason="delta"):
@@ -354,6 +369,9 @@ class WindowedJaxBackend(TrustBackend):
                 )
             if delta is not None:
                 PLAN_OUTCOMES.inc(outcome="delta")
+                JOURNAL.record(
+                    "plan", outcome="delta", backend=self.name, rows=int(rows.size)
+                )
                 return delta
         reason = "cold" if plan is None else (
             "stale-layout" if not valid else "fingerprint-miss"
@@ -362,6 +380,7 @@ class WindowedJaxBackend(TrustBackend):
             plan = build_window_plan(g.src, g.dst, w, n=g.n)
         PLAN_REBUILDS.inc()
         PLAN_OUTCOMES.inc(outcome="rebuild")
+        JOURNAL.record("plan", outcome="rebuild", backend=self.name, reason=reason)
         return plan
 
     def converge(self, graph, *, alpha=0.0, tol=1e-6, max_iter=50,
@@ -456,6 +475,7 @@ class ShardedJaxBackend(TrustBackend):
             elif swp.plan_outcome == "rebuild":
                 PLAN_REBUILDS.inc()
             PLAN_OUTCOMES.inc(outcome=swp.plan_outcome)
+            JOURNAL.record("plan", outcome=swp.plan_outcome, backend=name)
             self.plan = self.last_plan = swp.plan
             problem = swp
         else:

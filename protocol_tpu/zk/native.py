@@ -34,7 +34,11 @@ _I64P = ctypes.POINTER(ctypes.c_int64)
 
 #: Bump together with zk_abi_version() in native/zk_runtime.cpp whenever
 #: symbols are added or signatures change; _load() rebuilds a stale .so.
-_ABI_VERSION = 3
+_ABI_VERSION = 4
+
+#: Phase-timer table order — must match the ZkPhase enum in
+#: native/zk_runtime.cpp.
+PHASES = ("msm", "ntt", "gate_eval", "field_ops", "srs")
 
 
 def _rebuild():
@@ -117,6 +121,9 @@ def _load():
     lib.zk_scale_add.argtypes = [_U64P, _U64P, _U64P, ctypes.c_int64]
     lib.zk_poly_eval.argtypes = [_U64P, ctypes.c_int64, _U64P, _U64P]
     lib.zk_div_linear.argtypes = [_U64P, ctypes.c_int64, _U64P, _U64P]
+    lib.zk_phase_count.restype = ctypes.c_int64
+    lib.zk_phase_stats.argtypes = [_I64P]
+    lib.zk_phase_reset.argtypes = []
     lib.zk_abi_version.restype = ctypes.c_int64
     assert lib.zk_abi_version() == _ABI_VERSION
     _lib = lib
@@ -135,6 +142,52 @@ def available() -> bool:
 
 def _iptr(arr: np.ndarray):
     return arr.ctypes.data_as(_I64P)
+
+
+# -- phase attribution -------------------------------------------------
+
+
+def phase_stats() -> dict[str, dict[str, float]]:
+    """Cumulative engine phase table (deep attribution): phase name ->
+    ``{"calls": int, "seconds": float}``.  Monotonic since process
+    start (or the last :func:`reset_phase_stats`); the prover snapshots
+    it around a prove and bridges the delta into the epoch span tree.
+    Returns all-zero rows when the native runtime is unavailable, so
+    callers need no availability guard."""
+    if not available():
+        return {p: {"calls": 0, "seconds": 0.0} for p in PHASES}
+    lib = _load()
+    n = int(lib.zk_phase_count())
+    out = np.zeros((n, 2), dtype=np.int64)
+    lib.zk_phase_stats(_iptr(out))
+    stats: dict[str, dict[str, float]] = {}
+    for i, name in enumerate(PHASES):
+        calls, ns = (int(out[i, 0]), int(out[i, 1])) if i < n else (0, 0)
+        stats[name] = {"calls": calls, "seconds": ns / 1e9}
+    return stats
+
+
+def reset_phase_stats() -> None:
+    """Zero the engine phase table (tests and bench harnesses)."""
+    if available():
+        _load().zk_phase_reset()
+
+
+def phase_delta(
+    before: dict[str, dict[str, float]], after: dict[str, dict[str, float]]
+) -> dict[str, dict[str, float]]:
+    """Per-phase (calls, seconds) difference between two snapshots —
+    the attribution for one timed region (e.g. one SNARK prove)."""
+    return {
+        name: {
+            "calls": after[name]["calls"] - before.get(name, {}).get("calls", 0),
+            "seconds": round(
+                after[name]["seconds"] - before.get(name, {}).get("seconds", 0.0),
+                9,
+            ),
+        }
+        for name in after
+    }
 
 
 # -- public ops --------------------------------------------------------

@@ -36,7 +36,9 @@ behavioral parity targets only.
 
 from __future__ import annotations
 
+import contextlib
 import secrets
+import time
 from collections import Counter
 from dataclasses import dataclass, field as dc_field
 
@@ -44,6 +46,7 @@ import numpy as np
 
 from ..crypto import field
 from ..crypto.poseidon import PoseidonSponge
+from ..obs import TRACER
 from ..utils.limbs import from_limbs_fast, ptr as _ptr, to_limbs, to_limbs_fast
 from .bn254 import G1, GENERATOR
 from .cs import Column, ConstraintSystem
@@ -991,6 +994,66 @@ def _lagrange_eval(vals: dict[int, int], x: int, k: int) -> int:
 # ---------------------------------------------------------------------------
 
 
+class _ProveAttribution:
+    """Deep attribution for one ``prove()`` call: where did the SNARK
+    seconds go?
+
+    Two disjoint layers, attached as closed children of the enclosing
+    span (the manager's ``snark``) when the prove finishes:
+
+    - the native engine's phase-timer table (``zk.native.phase_stats``:
+      msm / ntt / gate_eval / field_ops / srs), delta'd over the whole
+      prove — the inner loops, with call counts;
+    - per-stage *host residuals* (``witness_gen`` / ``commit`` /
+      ``quotient`` / ``open``): each stage's wall-clock minus whatever
+      native engine time ran inside it, so the stage spans and the
+      engine spans partition the prove instead of double counting.
+
+    Without the native runtime the engine rows are zero and the stage
+    residuals are full stage wall-clock — attribution still sums to the
+    prove.  The table is process-global, so a concurrent native user on
+    another thread (e.g. an /aggregate verify) can inflate the engine
+    rows of an overlapping prove; attribution is diagnostic, not an
+    invariant, and the skew is bounded by that request's work.
+    """
+
+    def __init__(self) -> None:
+        from . import native as zk_native
+
+        self._native = zk_native
+        self._snap0 = zk_native.phase_stats()
+        self._stages: dict[str, list[float]] = {}  # name -> [host_s, calls]
+
+    @staticmethod
+    def _total_seconds(stats: dict[str, dict[str, float]]) -> float:
+        return sum(row["seconds"] for row in stats.values())
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        n0 = self._total_seconds(self._native.phase_stats())
+        try:
+            yield
+        finally:
+            wall = time.perf_counter() - t0
+            native = self._total_seconds(self._native.phase_stats()) - n0
+            rec = self._stages.setdefault(name, [0.0, 0])
+            rec[0] += max(wall - native, 0.0)
+            rec[1] += 1
+
+    def attach(self) -> None:
+        """Bridge the attribution into the current span tree (no-op
+        outside a span, e.g. direct prove() calls in tests)."""
+        delta = self._native.phase_delta(self._snap0, self._native.phase_stats())
+        for phase, row in delta.items():
+            if row["calls"] > 0:
+                TRACER.attach_closed(
+                    phase, row["seconds"], calls=int(row["calls"]), engine="native"
+                )
+        for name, (host_s, calls) in self._stages.items():
+            TRACER.attach_closed(name, host_s, calls=int(calls), engine="host")
+
+
 class _CosetEvaluator:
     """Evaluates y-combined constraint programs over the extended coset
     domain, with per-slot lazy materialization and refcounted frees."""
@@ -1115,6 +1178,9 @@ def prove(
             assert vals[row] == v % R, "instance values disagree with trace"
 
     rng = secrets.SystemRandom() if seed is None else __import__("random").Random(seed)
+    # Deep attribution (PERF.md §12): native engine phase deltas + host
+    # stage residuals, attached under the enclosing snark span.
+    att = _ProveAttribution()
 
     def blind(coeffs: np.ndarray, n_blind: int) -> np.ndarray:
         """p + r(X)·Z_H with r random of n_blind coefficients.  The mask
@@ -1145,10 +1211,11 @@ def prove(
     ]
 
     transcript = _TRANSCRIPTS[transcript][0]()
-    transcript.common_scalar(vk.digest)
-    for name in vk.instance_names:
-        for v in inst_map[name]:
-            transcript.common_scalar(v)
+    with att.stage("transcript"):
+        transcript.common_scalar(vk.digest)
+        for name in vk.instance_names:
+            for v in inst_map[name]:
+                transcript.common_scalar(v)
 
     slot_values: dict[int, list[int]] = {}
     n_adv, n_inst = len(advice), len(instance_cols)
@@ -1162,119 +1229,130 @@ def prove(
     # Round 1: advice commitments.  Zero-knowledge needs one blinder more
     # than the number of opening points, so derive the count from the
     # rotations each column is actually opened at instead of assuming 2.
-    advice_polys = [
-        blind(domain.ifft_arr(v), len(vk.gate_rots.get(i, ())) + 1)
-        for i, v in enumerate(advice_values)
-    ]
-    for p in advice_polys:
-        transcript.write_point(srs.commit(p))
+    with att.stage("witness_gen"):
+        advice_polys = [
+            blind(domain.ifft_arr(v), len(vk.gate_rots.get(i, ())) + 1)
+            for i, v in enumerate(advice_values)
+        ]
+    with att.stage("commit"):
+        for p in advice_polys:
+            transcript.write_point(srs.commit(p))
 
     # Round 1.5: lookup permutations (Halo2 ordering: theta after
     # advice, A'/S' commitments before beta/gamma).
-    theta = transcript.squeeze_challenge() if vk.lookups else 0
+    with att.stage("transcript"):
+        theta = transcript.squeeze_challenge() if vk.lookups else 0
     lk_a_vals: list[list[int]] = []  # compressed selector-gated inputs
     lk_t_vals: list[list[int]] = []  # compressed table
     lk_ap_vals: list[list[int]] = []  # A' (sorted input)
     lk_sp_vals: list[list[int]] = []  # S' (table permutation)
     lk_ap_polys: list[list[int]] = []
     lk_sp_polys: list[list[int]] = []
-    for lk in vk.lookups:
-        sel_vals = slot_values[lk.sel_slot]
-        padc = _theta_compress(lk.pad, theta)
-        a_comp = [
-            _theta_compress([slot_values[s][i] for s in lk.input_slots], theta)
-            if sel_vals[i]
-            else padc
-            for i in range(n)
-        ]
-        t_comp = [
-            _theta_compress(
-                [pk.fixed_values[ti][i] for ti in lk.table_fixed_idx], theta
-            )
-            for i in range(n)
-        ]
-        # Sort the active rows; build S' giving each first occurrence
-        # its table copy.
-        a_sorted = sorted(a_comp[: n - 1])
-        remaining = Counter(t_comp[: n - 1])
-        s_prime = [None] * (n - 1)
-        fill_rows = []
-        for i, val in enumerate(a_sorted):
-            if i == 0 or val != a_sorted[i - 1]:
-                if remaining[val] <= 0:
-                    raise AssertionError(
-                        f"lookup {lk.name}: input {val:#x} not in table"
-                    )
-                remaining[val] -= 1
-                s_prime[i] = val
-            else:
-                fill_rows.append(i)
-        leftovers = [v for v, c in sorted(remaining.items()) for _ in range(c)]
-        assert len(leftovers) == len(fill_rows)
-        for i, v in zip(fill_rows, leftovers):
-            s_prime[i] = v
-        lk_a_vals.append(a_comp)
-        lk_t_vals.append(t_comp)
-        lk_ap_vals.append(a_sorted + [0])
-        lk_sp_vals.append(list(s_prime) + [0])
-        ap_poly = blind(domain.ifft_arr(a_sorted + [0]), 3)
-        sp_poly = blind(domain.ifft_arr(list(s_prime) + [0]), 3)
-        lk_ap_polys.append(ap_poly)
-        lk_sp_polys.append(sp_poly)
-        transcript.write_point(srs.commit(ap_poly))
-        transcript.write_point(srs.commit(sp_poly))
-
-    beta = transcript.squeeze_challenge()
-    gamma = transcript.squeeze_challenge()
-
-    z_polys: list[list[int]] = []
-    z_values: list[list[int]] = []
-    start = 1
-    for chunk in vk.chunks:
-        nums, dens = [1] * n, [1] * n
-        for j in chunk:
-            vals = slot_values[vk.perm_slots[j]]
-            tag = vk.perm_tags[j]
-            sig = pk.sigma_values[j]
-            for i in range(n - 1):
-                nums[i] = (
-                    nums[i] * ((vals[i] + beta * tag % R * pk.row_tags[i] + gamma) % R) % R
+    with att.stage("witness_gen"):
+        for lk in vk.lookups:
+            sel_vals = slot_values[lk.sel_slot]
+            padc = _theta_compress(lk.pad, theta)
+            a_comp = [
+                _theta_compress([slot_values[s][i] for s in lk.input_slots], theta)
+                if sel_vals[i]
+                else padc
+                for i in range(n)
+            ]
+            t_comp = [
+                _theta_compress(
+                    [pk.fixed_values[ti][i] for ti in lk.table_fixed_idx], theta
                 )
-                dens[i] = dens[i] * ((vals[i] + beta * sig[i] + gamma) % R) % R
-        den_inv = _batch_inv(dens[: n - 1])
-        z = [0] * n
-        z[0] = start
-        for i in range(n - 1):
-            z[i + 1] = z[i] * nums[i] % R * den_inv[i] % R
-        start = z[n - 1]
-        z_values.append(z)
-        # z is opened at up to 3 rotations (−1, 0, 1); 4 blinders.
-        z_polys.append(blind(domain.ifft_arr(z), 4))
-    if vk.chunks:
-        assert start == 1, "permutation product != 1 (copy constraints broken?)"
-    for p in z_polys:
-        transcript.write_point(srs.commit(p))
+                for i in range(n)
+            ]
+            # Sort the active rows; build S' giving each first occurrence
+            # its table copy.
+            a_sorted = sorted(a_comp[: n - 1])
+            remaining = Counter(t_comp[: n - 1])
+            s_prime = [None] * (n - 1)
+            fill_rows = []
+            for i, val in enumerate(a_sorted):
+                if i == 0 or val != a_sorted[i - 1]:
+                    if remaining[val] <= 0:
+                        raise AssertionError(
+                            f"lookup {lk.name}: input {val:#x} not in table"
+                        )
+                    remaining[val] -= 1
+                    s_prime[i] = val
+                else:
+                    fill_rows.append(i)
+            leftovers = [v for v, c in sorted(remaining.items()) for _ in range(c)]
+            assert len(leftovers) == len(fill_rows)
+            for i, v in zip(fill_rows, leftovers):
+                s_prime[i] = v
+            lk_a_vals.append(a_comp)
+            lk_t_vals.append(t_comp)
+            lk_ap_vals.append(a_sorted + [0])
+            lk_sp_vals.append(list(s_prime) + [0])
+            ap_poly = blind(domain.ifft_arr(a_sorted + [0]), 3)
+            sp_poly = blind(domain.ifft_arr(list(s_prime) + [0]), 3)
+            lk_ap_polys.append(ap_poly)
+            lk_sp_polys.append(sp_poly)
+            transcript.write_point(srs.commit(ap_poly))
+            transcript.write_point(srs.commit(sp_poly))
 
-    # Lookup grand products Z_i over the active rows.
-    lk_z_polys: list[list[int]] = []
-    for li in range(len(vk.lookups)):
-        a_comp, t_comp = lk_a_vals[li], lk_t_vals[li]
-        ap, sp_ = lk_ap_vals[li], lk_sp_vals[li]
-        dens = [
-            (ap[i] + beta) % R * ((sp_[i] + gamma) % R) % R for i in range(n - 1)
-        ]
-        den_inv = _batch_inv(dens)
-        z = [0] * n
-        z[0] = 1
-        for i in range(n - 1):
-            num = (a_comp[i] + beta) % R * ((t_comp[i] + gamma) % R) % R
-            z[i + 1] = z[i] * num % R * den_inv[i] % R
-        assert z[n - 1] == 1, "lookup product != 1 (input not a table subset?)"
-        lk_z_polys.append(blind(domain.ifft_arr(z), 3))
-        transcript.write_point(srs.commit(lk_z_polys[-1]))
-    y = transcript.squeeze_challenge()
+    with att.stage("transcript"):
+        beta = transcript.squeeze_challenge()
+        gamma = transcript.squeeze_challenge()
+
+    with att.stage("witness_gen"):
+        z_polys: list[list[int]] = []
+        z_values: list[list[int]] = []
+        start = 1
+        for chunk in vk.chunks:  # within witness_gen accounting: host loop
+            nums, dens = [1] * n, [1] * n
+            for j in chunk:
+                vals = slot_values[vk.perm_slots[j]]
+                tag = vk.perm_tags[j]
+                sig = pk.sigma_values[j]
+                for i in range(n - 1):
+                    nums[i] = (
+                        nums[i] * ((vals[i] + beta * tag % R * pk.row_tags[i] + gamma) % R) % R
+                    )
+                    dens[i] = dens[i] * ((vals[i] + beta * sig[i] + gamma) % R) % R
+            den_inv = _batch_inv(dens[: n - 1])
+            z = [0] * n
+            z[0] = start
+            for i in range(n - 1):
+                z[i + 1] = z[i] * nums[i] % R * den_inv[i] % R
+            start = z[n - 1]
+            z_values.append(z)
+            # z is opened at up to 3 rotations (−1, 0, 1); 4 blinders.
+            z_polys.append(blind(domain.ifft_arr(z), 4))
+        if vk.chunks:
+            assert start == 1, "permutation product != 1 (copy constraints broken?)"
+    with att.stage("commit"):
+        for p in z_polys:
+            transcript.write_point(srs.commit(p))
+
+    with att.stage("witness_gen"):
+        # Lookup grand products Z_i over the active rows.
+        lk_z_polys: list[list[int]] = []
+        for li in range(len(vk.lookups)):
+            a_comp, t_comp = lk_a_vals[li], lk_t_vals[li]
+            ap, sp_ = lk_ap_vals[li], lk_sp_vals[li]
+            dens = [
+                (ap[i] + beta) % R * ((sp_[i] + gamma) % R) % R for i in range(n - 1)
+            ]
+            den_inv = _batch_inv(dens)
+            z = [0] * n
+            z[0] = 1
+            for i in range(n - 1):
+                num = (a_comp[i] + beta) % R * ((t_comp[i] + gamma) % R) % R
+                z[i + 1] = z[i] * num % R * den_inv[i] % R
+            assert z[n - 1] == 1, "lookup product != 1 (input not a table subset?)"
+            lk_z_polys.append(blind(domain.ifft_arr(z), 3))
+            transcript.write_point(srs.commit(lk_z_polys[-1]))
+    with att.stage("transcript"):
+        y = transcript.squeeze_challenge()
 
     # Round 3: quotient.
+    _quotient_stage = att.stage("quotient")
+    _quotient_stage.__enter__()
     ev = _CosetEvaluator(k, vk.ext_factor)
     n_fixed = len(vk.fixed_names)
     base_slots = n_adv + n_inst + n_fixed
@@ -1385,9 +1463,12 @@ def prove(
     nz = np.nonzero(t_arr.any(axis=1))[0]
     t_limbs = t_arr[: int(nz[-1]) + 1] if nz.size else t_arr[:1]
     t_chunks = [t_limbs[i : i + n] for i in range(0, t_limbs.shape[0], n)]
-    for chunk in t_chunks:
-        transcript.write_point(srs.commit(np.ascontiguousarray(chunk)))
-    x = transcript.squeeze_challenge()
+    _quotient_stage.__exit__(None, None, None)
+    with att.stage("commit"):
+        for chunk in t_chunks:
+            transcript.write_point(srs.commit(np.ascontiguousarray(chunk)))
+    with att.stage("transcript"):
+        x = transcript.squeeze_challenge()
 
     # Round 4: evaluations.
     entries = _opening_entries(vk, len(t_chunks))
@@ -1411,37 +1492,41 @@ def prove(
         return t_chunks[idx]
 
     evals: dict[tuple[str, int, int], int] = {}
-    for kind, idx, rots in entries:
-        p = poly_of(kind, idx)
-        for rot in rots:
+    with att.stage("open"):
+        for kind, idx, rots in entries:
+            p = poly_of(kind, idx)
+            for rot in rots:
+                pt = (
+                    x * pow(w, rot, R) % R
+                    if rot >= 0
+                    else x * pow(domain.omega_inv, -rot, R) % R
+                )
+                val = _poly_eval_arr(p, pt)
+                evals[(kind, idx, rot)] = val
+                transcript.write_scalar(val)
+    with att.stage("transcript"):
+        v = transcript.squeeze_challenge()
+
+    # Round 5: batched openings, one witness per rotation point.
+    all_rots = sorted({rot for _, _, rots in entries for rot in rots})
+    with att.stage("open"):
+        for rot in all_rots:
             pt = (
                 x * pow(w, rot, R) % R
                 if rot >= 0
                 else x * pow(domain.omega_inv, -rot, R) % R
             )
-            val = _poly_eval_arr(p, pt)
-            evals[(kind, idx, rot)] = val
-            transcript.write_scalar(val)
-    v = transcript.squeeze_challenge()
+            group = [e for e in entries if rot in e[2]]
+            max_len = max(poly_of(k, i).shape[0] for k, i, _ in group)
+            agg = np.zeros((max_len, 4), dtype=np.uint64)
+            v_pow = 1
+            for kind, idx, _rots in group:
+                _scale_add_arr(agg, poly_of(kind, idx), v_pow)
+                v_pow = v_pow * v % R
+            witness = _div_linear_arr(agg, pt)
+            transcript.write_point(srs.commit(witness))
 
-    # Round 5: batched openings, one witness per rotation point.
-    all_rots = sorted({rot for _, _, rots in entries for rot in rots})
-    for rot in all_rots:
-        pt = (
-            x * pow(w, rot, R) % R
-            if rot >= 0
-            else x * pow(domain.omega_inv, -rot, R) % R
-        )
-        group = [e for e in entries if rot in e[2]]
-        max_len = max(poly_of(k, i).shape[0] for k, i, _ in group)
-        agg = np.zeros((max_len, 4), dtype=np.uint64)
-        v_pow = 1
-        for kind, idx, _rots in group:
-            _scale_add_arr(agg, poly_of(kind, idx), v_pow)
-            v_pow = v_pow * v % R
-        witness = _div_linear_arr(agg, pt)
-        transcript.write_point(srs.commit(witness))
-
+    att.attach()
     return transcript.finalize()
 
 

@@ -382,3 +382,71 @@ class TestObservabilityBoundaryRules:
             "    log.info('tick took %s', time.perf_counter() - t0)\n",
         )
         assert findings == []
+
+
+class TestJournalBoundaryRule:
+    """Pass 5: flight-recorder writes are host-boundary-only (ISSUE 6)."""
+
+    def test_journal_write_in_jit(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/x.py",
+            "import jax\nfrom protocol_tpu.obs.journal import JOURNAL\n"
+            "@jax.jit\ndef f(x):\n"
+            "    JOURNAL.record('iter', x=x)\n"
+            "    return x * 2\n",
+        )
+        assert [f.rule for f in findings] == ["journal-write-in-jit"]
+        assert findings[0].file == "protocol_tpu/trust/x.py"
+        assert findings[0].line == 5
+
+    def test_journal_dump_in_shard_map_body(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/x.py",
+            "import jax\nfrom protocol_tpu.obs.journal import JOURNAL\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "@shard_map\ndef step(x):\n"
+            "    JOURNAL.dump('/tmp/x')\n"
+            "    return x\n",
+        )
+        assert [f.rule for f in findings] == ["journal-write-in-jit"]
+        assert findings[0].line == 6
+
+    def test_instance_journal_receiver_detected(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/x.py",
+            "import jax\n"
+            "@jax.jit\ndef f(self, x):\n"
+            "    self._journal.record('iter')\n"
+            "    return x\n",
+        )
+        assert [f.rule for f in findings] == ["journal-write-in-jit"]
+
+    def test_journal_write_at_host_boundary_is_fine(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/x.py",
+            "from protocol_tpu.obs.journal import JOURNAL\n"
+            "def tick():\n"
+            "    JOURNAL.record('epoch', n=1)\n",
+        )
+        assert findings == []
+
+    def test_unrelated_record_method_is_fine(self, tmp_path):
+        """Only journal-shaped receivers are fenced — e.g. a metrics
+        recorder or audio ``record()`` API must not trip the rule."""
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/x.py",
+            "import jax\n"
+            "@jax.jit\ndef f(stats, x):\n"
+            "    stats.record(x)\n"
+            "    return x\n",
+        )
+        assert findings == []
+
+    def test_seeded_fixture_registered(self):
+        assert "journal-write-in-jit" in FIXTURES
+        assert FIXTURES["journal-write-in-jit"].kind == "ast"

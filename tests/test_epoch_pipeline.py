@@ -566,3 +566,59 @@ class TestBenchEpochs:
         assert entry["warm_vs_cold_l1"] < 1e-4
         assert entry["plan_outcomes"]["delta"] >= 1
         assert len(entry["per_epoch"]) == 2
+
+
+class TestRecompileTracker:
+    """ISSUE 6: PR 5's stable-shape guarantee, *watched* instead of
+    asserted — steady-state delta epochs must report zero jit cache
+    misses; a shape-changing epoch must report exactly one."""
+
+    def test_steady_state_delta_epochs_report_zero_recompiles(self):
+        from protocol_tpu.obs import metrics as obs_metrics
+        from protocol_tpu.obs.watchers import RECOMPILES
+
+        rng = np.random.default_rng(7)
+        g = scale_free(1500, 9000, seed=11).drop_self_edges()
+        b = get_backend("tpu-windowed")
+        prev = b.converge(g, alpha=0.1, tol=1e-6, max_iter=60)  # cold compile
+        scores = prev.scores
+        cur = g
+        counter_before = obs_metrics.JIT_RECOMPILES.value(fn="converge_windowed")
+        for k in range(3):
+            cur, rows = churn_graph(cur, 0.01, rng)
+            b.delta_rows = rows
+            snap = RECOMPILES.snapshot()
+            res = b.converge(cur, alpha=0.1, tol=1e-6, max_iter=60, t0=scores)
+            misses = RECOMPILES.observe(snap, steady_state=True, epoch=k)
+            assert misses == {}, (
+                f"steady-state delta epoch {k} recompiled: {misses}"
+            )
+            scores = res.scores
+        # The delta epochs really took the delta path (shape-stable).
+        assert obs_metrics.JIT_RECOMPILES.value(fn="converge_windowed") == (
+            counter_before
+        )
+
+    def test_shape_changing_epoch_reports_exactly_one(self):
+        from protocol_tpu.obs import metrics as obs_metrics
+        from protocol_tpu.obs.watchers import RECOMPILES
+
+        # A peer-count no other test in this module uses: guaranteed
+        # novel device shapes for converge_windowed.
+        g = scale_free(1777, 9300, seed=23).drop_self_edges()
+        b = get_backend("tpu-windowed")
+        counter_before = obs_metrics.JIT_RECOMPILES.value(fn="converge_windowed")
+        snap = RECOMPILES.snapshot()
+        b.converge(g, alpha=0.1, tol=1e-6, max_iter=30)
+        misses = RECOMPILES.observe(snap, steady_state=False, epoch=0)
+        assert misses.get("converge_windowed") == 1, misses
+        assert obs_metrics.JIT_RECOMPILES.value(fn="converge_windowed") == (
+            counter_before + 1
+        )
+
+    def test_registered_entry_points(self):
+        from protocol_tpu.obs.watchers import RECOMPILES
+
+        names = RECOMPILES.registered()
+        for fn in ("converge_csr", "converge_sparse", "converge_windowed"):
+            assert fn in names, names

@@ -573,3 +573,351 @@ class TestProfileSession:
         with profile_session(str(tmp_path / "prof")):
             jnp.asarray(np.ones(8, np.float32)).sum().block_until_ready()
         assert any((tmp_path / "prof").rglob("*")), "no profiler output written"
+
+
+# ---------------------------------------------------------------------------
+# Exposition hardening (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _unescape_label(value: str) -> str:
+    """Inverse of the exposition-format label escaping."""
+    out, i = [], 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+class TestExpositionHardening:
+    def test_hostile_reason_label_round_trips(self):
+        """A reason label containing every escapable character must
+        render as a parseable sample whose unescaped value recovers
+        the original string byte-for-byte."""
+        hostile = 'quote:" backslash:\\ newline:\nend'
+        reg = MetricsRegistry()
+        reg.counter("rej_total", "rejections", labelnames=("reason",)).inc(
+            reason=hostile
+        )
+        from protocol_tpu.obs.export import prometheus_text
+
+        text = prometheus_text(reg)
+        samples = _parse_prometheus(text)  # every line must stay well-formed
+        (label_line,) = [k for k in samples if k.startswith("rej_total{")]
+        m = re.match(r'rej_total\{reason="(.*)"\}', label_line)
+        assert m is not None
+        assert _unescape_label(m.group(1)) == hostile
+        assert samples[label_line] == 1
+
+    def test_help_text_escaped(self):
+        reg = MetricsRegistry()
+        reg.gauge("g", "first line\nsecond \\ line").set(1)
+        from protocol_tpu.obs.export import prometheus_text
+
+        text = prometheus_text(reg)
+        (help_line,) = [
+            line for line in text.splitlines() if line.startswith("# HELP g ")
+        ]
+        assert help_line == "# HELP g first line\\nsecond \\\\ line"
+        _parse_prometheus(text)
+
+    def test_content_type_version(self):
+        from protocol_tpu.obs.export import PROMETHEUS_CONTENT_TYPE
+
+        assert PROMETHEUS_CONTENT_TYPE.startswith("text/plain; version=0.0.4")
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_tail_ordered(self):
+        from protocol_tpu.obs.journal import FlightRecorder
+
+        rec = FlightRecorder(max_events=64)
+        for i in range(500):
+            rec.record("tick", i=i)
+        assert len(rec) == 64
+        tail = rec.tail(10)
+        assert [e["i"] for e in tail] == list(range(490, 500))
+        assert all(e["kind"] == "tick" for e in tail)
+        seqs = [e["seq"] for e in rec.tail()]
+        assert seqs == sorted(seqs)
+
+    def test_batched_writer_lands_events_on_disk(self, tmp_path):
+        from protocol_tpu.obs.journal import FlightRecorder
+
+        path = tmp_path / "j.jsonl"
+        rec = FlightRecorder(max_events=128).configure(path)
+        for i in range(20):
+            rec.record("span", name=f"s{i}", duration_s=0.1)
+        rec.close()
+        lines = [json.loads(x) for x in path.read_text().splitlines()]
+        assert [e["name"] for e in lines] == [f"s{i}" for i in range(20)]
+
+    def test_rotation_keeps_recent_window(self, tmp_path):
+        from protocol_tpu.obs.journal import FlightRecorder
+
+        path = tmp_path / "j.jsonl"
+        rec = FlightRecorder(max_events=50, max_bytes=2000).configure(path)
+        for i in range(400):
+            rec.record("tick", i=i)
+        rec.close()
+        assert path.stat().st_size < 10_000  # bounded, not 400 lines' worth
+        lines = [json.loads(x) for x in path.read_text().splitlines()]
+        assert lines  # the recent window survived
+        assert lines[-1]["i"] == 399
+
+    def test_dump_writes_ring_plus_marker(self, tmp_path):
+        from protocol_tpu.obs.journal import FlightRecorder
+
+        rec = FlightRecorder(max_events=32)
+        for i in range(5):
+            rec.record("tick", i=i)
+        out = rec.dump(tmp_path / "post" / "mortem.jsonl", reason="test")
+        lines = [json.loads(x) for x in out.read_text().splitlines()]
+        assert len(lines) == 6
+        assert lines[-1]["kind"] == "journal-dump"
+        assert lines[-1]["reason"] == "test" and lines[-1]["events"] == 5
+
+    def test_record_never_raises_on_unserializable(self, tmp_path):
+        from protocol_tpu.obs.journal import FlightRecorder
+
+        rec = FlightRecorder().configure(tmp_path / "j.jsonl")
+        rec.record("weird", obj=object())  # json falls back to str()
+        rec.close()
+        line = json.loads((tmp_path / "j.jsonl").read_text().splitlines()[0])
+        assert "object object" in line["obj"]
+
+    def test_span_close_feeds_global_journal(self):
+        from protocol_tpu.obs import JOURNAL
+
+        before = len(JOURNAL.tail())
+        with TRACER.span("journal_unit_phase"):
+            pass
+        events = JOURNAL.tail()
+        assert len(events) > before
+        assert any(
+            e["kind"] == "span" and e.get("name") == "journal_unit_phase"
+            for e in events
+        )
+
+
+# ---------------------------------------------------------------------------
+# Watchers: recompiles, memory watermarks, drift
+# ---------------------------------------------------------------------------
+
+
+class TestRecompileTrackerUnit:
+    def test_snapshot_observe_counts_misses(self):
+        import jax.numpy as jnp
+
+        from protocol_tpu.obs.watchers import RecompileTracker
+
+        tracker = RecompileTracker()
+
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        tracker.register("f", f)
+        f(jnp.ones(3))
+        snap = tracker.snapshot()
+        assert tracker.observe(snap) == {}  # no new shapes
+        f(jnp.ones(7))  # fresh shape -> one compile
+        assert tracker.observe(snap) == {"f": 1}
+
+    def test_non_jit_objects_are_ignored(self):
+        from protocol_tpu.obs.watchers import RecompileTracker
+
+        tracker = RecompileTracker()
+        tracker.register("not_jit", lambda x: x)
+        assert tracker.registered() == []
+
+
+class TestMemoryWatermarks:
+    def test_disables_itself_without_allocator_stats(self):
+        from protocol_tpu.obs.watchers import MemoryWatermarkWatcher
+
+        w = MemoryWatermarkWatcher()
+        with TRACER.span("mem_probe") as sp:
+            w.on_open(sp)
+        # CPU devices report no memory_stats: the watcher must neither
+        # leave snapshot attrs behind nor claim a delta.
+        if jax.local_devices()[0].memory_stats() is None:
+            assert w._enabled is False
+            assert "dev_mem_delta_bytes" not in sp.attrs
+            assert "_mem_open_bytes" not in sp.attrs
+
+    def test_records_delta_with_fake_stats(self):
+        from protocol_tpu.obs.watchers import MemoryWatermarkWatcher
+
+        class Fake(MemoryWatermarkWatcher):
+            def __init__(self):
+                super().__init__()
+                self.now = 1000
+
+            def _bytes_in_use(self):
+                return (self.now, self.now + 7)
+
+        w = Fake()
+        with TRACER.span("mem_fake") as sp:
+            w.on_open(sp)
+            w.now = 1500
+            w.on_close(sp)
+        assert sp.attrs["dev_mem_delta_bytes"] == 500
+        assert sp.attrs["dev_mem_peak_bytes"] == 1507
+        assert "_mem_open_bytes" not in sp.attrs
+        assert obs_metrics.DEVICE_MEMORY_DELTA.value(phase="mem_fake") == 500
+
+
+class TestScoreDriftMonitor:
+    def test_first_epoch_has_no_drift_then_l1_linf(self):
+        from protocol_tpu.obs.watchers import ScoreDriftMonitor
+
+        mon = ScoreDriftMonitor(top_k=2)
+        first = mon.observe(1, [10, 11, 12], [0.5, 0.3, 0.2])
+        assert first["l1"] is None and first["top_movers"] == []
+        second = mon.observe(2, [10, 11, 13], [0.4, 0.35, 0.25])
+        assert abs(second["l1"] - 0.15) < 1e-12
+        assert abs(second["linf"] - 0.1) < 1e-12
+        assert second["joined"] == 1 and second["departed"] == 1
+        movers = second["top_movers"]
+        assert movers[0]["peer_hash"] == hex(10)
+        assert abs(movers[0]["delta"] + 0.1) < 1e-12
+        assert mon.last()["epoch"] == 2
+
+    def test_residual_stall_detection(self):
+        from protocol_tpu.obs.watchers import ScoreDriftMonitor
+
+        mon = ScoreDriftMonitor()
+        before = obs_metrics.RESIDUAL_STALLS.value()
+        ok = mon.observe(1, [1], [1.0], residuals=[0.5, 0.4, 0.41, 0.2])
+        assert ok["residual_increases"] == 1 and not ok["stalled"]
+        bad = mon.observe(2, [1], [1.0], residuals=[0.5, 0.6, 0.4, 0.55])
+        assert bad["residual_increases"] == 2 and bad["stalled"]
+        assert obs_metrics.RESIDUAL_STALLS.value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# New node endpoints: /scores/drift and /debug/flight
+# ---------------------------------------------------------------------------
+
+
+class TestDeepAttributionEndpoints:
+    def test_drift_endpoint_after_tick(self):
+        from protocol_tpu.obs.watchers import DRIFT
+
+        DRIFT.reset()
+        m = _ticked_manager()
+        status, body = handle_request("GET", "/scores/drift", m)
+        assert status == 200
+        drift = json.loads(body)
+        assert drift["epoch"] == 4
+        assert drift["peers"] == 5
+        assert "stalled" in drift and "top_movers" in drift
+
+    def test_flight_endpoint_serves_jsonl_tail(self):
+        m = _ticked_manager()
+        status, body = handle_request("GET", "/debug/flight", m)
+        assert status == 200
+        events = [json.loads(line) for line in body.splitlines() if line]
+        assert events, "flight recorder empty after a full tick"
+        kinds = {e["kind"] for e in events}
+        assert "span" in kinds
+        status, limited = handle_request("GET", "/debug/flight?n=3", m)
+        assert status == 200
+        assert len(limited.splitlines()) == 3
+        status, _ = handle_request("GET", "/debug/flight?n=bogus", m)
+        assert status == 400
+
+    def test_flight_tail_replays_ingest_rejection(self):
+        from protocol_tpu.crypto.eddsa import SecretKey, sign
+        from protocol_tpu.obs import JOURNAL
+        from tests.test_node import make_attestation
+
+        bad_sig = make_attestation(1)
+        bad_sig.sig = sign(SecretKey.random(), SecretKey.random().public(), 1)
+        m = Manager()
+        m.add_attestations_bulk([bad_sig])
+        rejects = [
+            e for e in JOURNAL.tail() if e["kind"] == "ingest-reject"
+        ]
+        assert rejects and rejects[-1]["reason"] == "bad-signature"
+
+
+# ---------------------------------------------------------------------------
+# Prover-internal spans (deep attribution)
+# ---------------------------------------------------------------------------
+
+
+class TestProverSubSpans:
+    def test_attach_closed_hangs_child_under_current_span(self):
+        tracer = Tracer()
+        with tracer.epoch(21):
+            with tracer.span("snark"):
+                sp = tracer.attach_closed("msm", 0.125, calls=7)
+                assert sp is not None
+        tree = tracer.get_trace(21)
+        (snark,) = tree["children"]
+        (msm,) = snark["children"]
+        assert msm["name"] == "msm"
+        assert msm["duration_s"] == 0.125
+        assert msm["attrs"]["calls"] == 7
+        assert msm["start_offset_s"] >= 0
+
+    def test_attach_closed_without_open_span_is_noop(self):
+        tracer = Tracer()
+        assert tracer.attach_closed("msm", 1.0) is None
+
+    def test_plonk_prove_attributes_engine_and_stage_time(self):
+        """The acceptance shape: snark -> named prover sub-spans with
+        call counts, summing to (nearly) the whole snark span."""
+        from protocol_tpu.zk import native as zk_native, plonk
+        from tests.test_plonk import _mul_add_circuit
+
+        cs = _mul_add_circuit()
+        pk = plonk.compile_circuit(cs)
+        with TRACER.epoch(31):
+            with TRACER.span("prove"):
+                with TRACER.span("snark"):
+                    proof = plonk.prove(pk, cs, [17], seed=b"t")
+        assert plonk.verify(pk.vk, [17], proof)
+        tree = TRACER.get_trace(31)
+        snark = tree["children"][0]["children"][0]
+        by_name = {c["name"]: c for c in snark["children"]}
+        expected = {"witness_gen", "commit", "quotient", "open", "transcript"}
+        if zk_native.available():
+            expected |= {"msm", "ntt"}
+        assert expected <= set(by_name), sorted(by_name)
+        assert len(by_name) >= 4
+        for child in by_name.values():
+            assert child["duration_s"] >= 0
+            assert child["attrs"]["calls"] >= 1
+        covered = sum(c["duration_s"] for c in snark["children"])
+        assert covered <= snark["duration_s"] * 1.05  # disjoint, no double count
+        assert covered >= snark["duration_s"] * 0.5  # attribution is substantial
+
+    def test_native_phase_stats_accumulate_and_reset(self):
+        from protocol_tpu.zk import native as zk_native
+
+        if not zk_native.available():
+            pytest.skip("native zk runtime unavailable")
+        zk_native.reset_phase_stats()
+        before = zk_native.phase_stats()
+        assert before["msm"] == {"calls": 0, "seconds": 0.0}
+        root = pow(5, (zk_native.R - 1) >> 2, zk_native.R)
+        zk_native.ntt([1, 2, 3, 4], root)
+        after = zk_native.phase_stats()
+        assert after["ntt"]["calls"] == 1
+        assert after["ntt"]["seconds"] >= 0
+        delta = zk_native.phase_delta(before, after)
+        assert delta["ntt"]["calls"] == 1 and delta["msm"]["calls"] == 0

@@ -1,0 +1,172 @@
+"""Perf-regression sentinel (ISSUE 6): the committed BENCH_r*.json
+trajectory is finally *read* — the sentinel gates on per-metric trend
+deltas against the best recorded value."""
+
+import json
+import shutil
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import perf_sentinel
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _record(n: int, value: float, metric: str = "headline seconds") -> dict:
+    return {
+        "n": n,
+        "parsed": {"metric": metric, "value": value, "unit": "seconds"},
+    }
+
+
+def _write_rounds(path: Path, values: list[float], **kw) -> None:
+    for i, v in enumerate(values, start=1):
+        (path / f"BENCH_r{i:02d}.json").write_text(json.dumps(_record(i, v, **kw)))
+
+
+class TestRealSeries:
+    def test_committed_bench_series_passes(self, tmp_path):
+        """Acceptance: exit 0 on the real BENCH_r01..r05 series."""
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(REPO), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert report["ok"] is True
+        assert any("BENCH_r01.json" in f for f in report["history_files"])
+        # The headline series was parsed across all five rounds.
+        headline = [k for k in report["series"] if "convergence wall-clock" in k]
+        assert headline and report["series"][headline[0]]["rounds"] == 5
+
+
+class TestSyntheticRegression:
+    def test_regressed_latest_round_fails(self, tmp_path):
+        """Acceptance: exit non-zero on a synthetically regressed
+        fixture — the newest round is >threshold worse than the best."""
+        for f in REPO.glob("BENCH_r0*.json"):
+            shutil.copy(f, tmp_path / f.name)
+        rec = json.loads((REPO / "BENCH_r05.json").read_text())
+        rec["n"] = 6
+        rec["parsed"]["value"] = rec["parsed"]["value"] * 3
+        (tmp_path / "BENCH_r06.json").write_text(json.dumps(rec))
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1
+        report = json.loads(out.read_text())
+        assert report["ok"] is False
+        assert len(report["regressions"]) == 1
+        row = report["series"][report["regressions"][0]]
+        assert row["status"] == "REGRESSED"
+        assert row["candidate_source"] == "BENCH_r06.json"
+
+    def test_within_threshold_wobble_passes(self, tmp_path):
+        _write_rounds(tmp_path, [10.0, 8.0, 8.5])  # 6.25% above best
+        rc = perf_sentinel.main(
+            ["--history", str(tmp_path), "--out", str(tmp_path / "s.json")]
+        )
+        assert rc == 0
+
+    def test_threshold_is_configurable(self, tmp_path):
+        _write_rounds(tmp_path, [10.0, 8.0, 8.5])
+        rc = perf_sentinel.main(
+            [
+                "--history", str(tmp_path),
+                "--threshold", "0.05",
+                "--out", str(tmp_path / "s.json"),
+            ]
+        )
+        assert rc == 1
+
+    def test_higher_is_better_metrics_gate_downward(self, tmp_path):
+        for i, sigs in enumerate([3000.0, 3554.0, 1000.0], start=1):
+            rec = {
+                "n": i,
+                "parsed": {
+                    "metric": "sustained ingest",
+                    "value": 1.0,
+                    "unit": "seconds",
+                    "sigs_per_s": sigs,
+                },
+            }
+            (tmp_path / f"BENCH_r{i:02d}.json").write_text(json.dumps(rec))
+        out = tmp_path / "s.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1
+        report = json.loads(out.read_text())
+        assert any("sigs_per_s" in k for k in report["regressions"])
+
+
+class TestFreshRun:
+    def test_fresh_entry_gates_against_recorded_best(self, tmp_path):
+        _write_rounds(tmp_path, [10.0, 8.0, 8.1])
+        fresh = {"metric": "headline seconds", "value": 12.0, "unit": "seconds"}
+        (tmp_path / "FRESH.json").write_text(json.dumps(fresh))
+        rc = perf_sentinel.main(
+            [
+                "--history", str(tmp_path),
+                "--fresh", str(tmp_path / "FRESH.json"),
+                "--out", str(tmp_path / "s.json"),
+            ]
+        )
+        assert rc == 1
+        report = json.loads((tmp_path / "s.json").read_text())
+        row = report["series"][report["regressions"][0]]
+        assert row["candidate_source"] == "fresh" and row["candidate"] == 12.0
+
+    def test_fresh_smoke_scale_never_compared(self, tmp_path):
+        """A differently-shaped fresh run (CI smoke) has a different
+        metric string — it must report as no-baseline, never gate."""
+        _write_rounds(tmp_path, [10.0, 8.0])
+        fresh = {
+            "metric": "smoke-scale convergence (tpu-csr)",
+            "value": 999.0,
+            "unit": "seconds",
+        }
+        (tmp_path / "FRESH.json").write_text(json.dumps(fresh))
+        rc = perf_sentinel.main(
+            [
+                "--history", str(tmp_path),
+                "--fresh", str(tmp_path / "FRESH.json"),
+                "--out", str(tmp_path / "s.json"),
+            ]
+        )
+        assert rc == 0
+        report = json.loads((tmp_path / "s.json").read_text())
+        smoke = [k for k in report["series"] if "smoke-scale" in k]
+        assert report["series"][smoke[0]]["status"] == "no-baseline"
+
+    def test_richer_epoch_metrics_are_tracked(self, tmp_path):
+        """cold/steady-state epoch seconds and plan seconds from an
+        epochs-mode bench entry become their own gated series."""
+        entry = {
+            "metric": "steady-state epoch wall-clock",
+            "value": 5.82,
+            "unit": "seconds",
+            "cold_epoch_seconds": 7.37,
+            "steady_state_epoch_seconds": 5.82,
+            "plan_seconds": 2.5,
+        }
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps({"n": 1, "parsed": entry})
+        )
+        regressed = dict(entry, steady_state_epoch_seconds=9.0, value=9.0)
+        (tmp_path / "BENCH_r02.json").write_text(
+            json.dumps({"n": 2, "parsed": regressed})
+        )
+        out = tmp_path / "s.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1
+        report = json.loads(out.read_text())
+        assert any(
+            "steady_state_epoch_seconds" in k for k in report["regressions"]
+        )
+        assert any("plan_seconds" in k for k in report["series"])
+
+
+class TestNoHistory:
+    def test_missing_history_is_usage_error(self, tmp_path):
+        rc = perf_sentinel.main(
+            ["--history", str(tmp_path), "--out", str(tmp_path / "s.json")]
+        )
+        assert rc == 2

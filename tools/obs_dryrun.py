@@ -66,11 +66,23 @@ async def _dryrun(out_dir: Path, epoch_interval: int, timeout_s: float) -> int:
 
     metrics_head, metrics_body = await _http_get(port, "/metrics")
     trace_head, trace_body = await _http_get(port, "/trace/latest")
+    latest = TRACER.latest_epoch()
+    _, trace_by_number = await _http_get(port, f"/trace/{latest}")
+    drift_head, drift_body = await _http_get(port, "/scores/drift")
+    flight_head, flight_body = await _http_get(port, "/debug/flight")
     await node.stop()
 
     assert "200 OK" in metrics_head, metrics_head
     assert "text/plain; version=0.0.4" in metrics_head, metrics_head
     assert "200 OK" in trace_head, trace_head
+    assert "200 OK" in drift_head, drift_head
+    assert "200 OK" in flight_head, flight_head
+
+    # /trace/latest must be BYTE-identical to /trace/<epoch> for the
+    # newest epoch — same serialized tree, not a re-render.
+    assert trace_body == trace_by_number, (
+        f"/trace/latest diverges from /trace/{latest}"
+    )
 
     # -- acceptance shape ----------------------------------------------
     samples: dict[str, float] = {}
@@ -91,13 +103,41 @@ async def _dryrun(out_dir: Path, epoch_interval: int, timeout_s: float) -> int:
     child_names = [c["name"] for c in tree["children"]]
     assert "prove" in child_names and "converge" in child_names, child_names
 
+    # Span-tree integrity: every span closed (an orphaned span would
+    # serialize duration_s=None) and every span's end >= start.
+    def check_spans(node, path="epoch_tick"):
+        dur = node["duration_s"]
+        assert dur is not None, f"orphaned (never-closed) span: {path}"
+        assert dur >= 0, f"span end < start at {path}: {dur}"
+        assert node["start_offset_s"] >= 0, (path, node["start_offset_s"])
+        for child in node["children"]:
+            check_spans(child, f"{path}/{child['name']}")
+
+    check_spans(tree)
+
+    # Drift endpoint: one epoch has landed, so the monitor has a
+    # summary (no previous fixed point yet -> l1/linf are null).
+    drift = json.loads(drift_body)
+    assert drift.get("epoch") == tree["attrs"]["epoch"], drift
+    assert "stalled" in drift, drift
+
+    # Flight recorder: the tail must replay the tick's event sequence
+    # — spans (incl. the epoch root) and the plan/converge phases.
+    flight = [json.loads(line) for line in flight_body.splitlines() if line]
+    kinds = {e["kind"] for e in flight}
+    assert "span" in kinds, kinds
+    span_names = {e.get("name") for e in flight if e["kind"] == "span"}
+    assert "epoch_tick" in span_names and "converge" in span_names, span_names
+
     out_dir.mkdir(parents=True, exist_ok=True)
     (out_dir / "METRICS_scrape.txt").write_text(metrics_body)
     (out_dir / "TRACE_epoch0.json").write_text(json.dumps(tree, indent=2) + "\n")
+    (out_dir / "FLIGHT_tail.jsonl").write_text(flight_body)
     print(
         f"obs_dryrun: OK — epoch {tree['attrs']['epoch']}, "
         f"{int(iterations)} iterations, {int(residual_count)} residuals, "
-        f"phases {child_names}; artifacts in {out_dir}/"
+        f"phases {child_names}, {len(flight)} flight events; "
+        f"artifacts in {out_dir}/"
     )
     return 0
 

@@ -1,0 +1,269 @@
+"""Perf-regression sentinel over the committed bench trajectory.
+
+The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
+``LADDER_r*.json``) but nothing ever *read* the series — a PR could
+halve headline throughput and no gate would notice.  This tool closes
+the loop: it parses the recorded rounds into per-metric series
+(headline convergence seconds, cold/steady-state epoch seconds, plan
+build seconds, sigs/s, power-iters/s), optionally folds in a fresh
+bench entry, and exits non-zero when the newest value regresses more
+than ``--threshold`` against the best value the repo has ever
+recorded.
+
+Series are keyed by the exact ``metric`` string plus the field name,
+so differently-shaped runs (CI smoke vs the recorded 1M-peer rounds)
+never get compared against each other; a fresh entry with no matching
+history is reported as ``no-baseline`` and cannot fail the gate.
+
+Directionality: ``*seconds*`` metrics regress upward, throughput
+metrics (``*/s``, ``*per_sec*``) regress downward.
+
+Run (CI ``perf-sentinel`` job)::
+
+    python tools/perf_sentinel.py --out SENTINEL.json
+    python tools/perf_sentinel.py --fresh FRESH.json --threshold 0.10
+
+Exit code 0 = no regression; 1 = regression (details in SENTINEL.json
+and on stderr); 2 = usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Iterator
+
+#: Named numeric fields lifted from a bench entry into their own
+#: series: field -> lower_is_better.
+_FIELDS = {
+    "value": None,  # direction inferred from unit/metric
+    "plan_seconds": True,
+    "cold_epoch_seconds": True,
+    "steady_state_epoch_seconds": True,
+    "sigs_per_s": False,
+    "power_iters_per_sec": False,
+}
+
+
+def _lower_is_better(field: str, entry: dict[str, Any]) -> bool | None:
+    fixed = _FIELDS.get(field)
+    if fixed is not None:
+        return fixed
+    unit = str(entry.get("unit", ""))
+    metric = str(entry.get("metric", ""))
+    if "second" in unit or "seconds" in metric:
+        return True
+    if re.search(r"(/s\b|per_sec|per second)", unit + " " + metric):
+        return False
+    return None  # unknown: not gated
+
+
+def _entries(obj: Any) -> Iterator[dict[str, Any]]:
+    """Every bench entry inside one parsed JSON document: driver
+    records ({"parsed": {...}}), ladder reports ({"ladder": [...]}),
+    bare entries, or lists of any of those."""
+    if isinstance(obj, list):
+        for item in obj:
+            yield from _entries(item)
+        return
+    if not isinstance(obj, dict):
+        return
+    if "parsed" in obj and isinstance(obj["parsed"], dict):
+        yield from _entries(obj["parsed"])
+        return
+    if "ladder" in obj and isinstance(obj["ladder"], list):
+        yield from _entries(obj["ladder"])
+        return
+    if "metric" in obj:
+        yield obj
+
+
+def _round_of(path: Path, obj: Any) -> int:
+    if isinstance(obj, dict) and isinstance(obj.get("n"), int):
+        return obj["n"]
+    m = re.search(r"_r(\d+)", path.name)
+    return int(m.group(1)) if m else 0
+
+
+def collect_series(paths: list[Path]) -> dict[str, list[dict[str, Any]]]:
+    """{series key: [{round, value, lower_is_better, source}, ...]}
+    sorted by round.  A series key is ``<metric string> :: <field>``."""
+    series: dict[str, list[dict[str, Any]]] = {}
+    for path in sorted(paths):
+        try:
+            obj = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"perf_sentinel: skipping {path}: {exc}", file=sys.stderr)
+            continue
+        rnd = _round_of(path, obj)
+        for entry in _entries(obj):
+            for fld in _FIELDS:
+                val = entry.get(fld)
+                if not isinstance(val, (int, float)) or isinstance(val, bool):
+                    continue
+                direction = _lower_is_better(fld, entry)
+                if direction is None:
+                    continue
+                key = f"{entry['metric']} :: {fld}"
+                series.setdefault(key, []).append(
+                    {
+                        "round": rnd,
+                        "value": float(val),
+                        "lower_is_better": direction,
+                        "source": path.name,
+                    }
+                )
+    for points in series.values():
+        points.sort(key=lambda p: (p["round"], p["source"]))
+    return series
+
+
+def evaluate(
+    series: dict[str, list[dict[str, Any]]],
+    fresh: dict[str, float] | None,
+    threshold: float,
+) -> dict[str, Any]:
+    """Per-series verdict: the candidate (the fresh value when one
+    matches, else the newest recorded round) against the best recorded
+    value.  ``regressed`` iff candidate is worse than best by more
+    than ``threshold`` (relative)."""
+    report: dict[str, Any] = {"series": {}, "regressions": []}
+    fresh = fresh or {}
+    seen_fresh: set[str] = set()
+    for key, points in sorted(series.items()):
+        lower = points[0]["lower_is_better"]
+        values = [p["value"] for p in points]
+        best = min(values) if lower else max(values)
+        candidate = fresh.get(key)
+        source = "fresh"
+        if candidate is None:
+            candidate = points[-1]["value"]
+            source = points[-1]["source"]
+        else:
+            seen_fresh.add(key)
+        if lower:
+            delta = (candidate - best) / best if best > 0 else 0.0
+        else:
+            delta = (best - candidate) / best if best > 0 else 0.0
+        regressed = delta > threshold
+        row = {
+            "best": best,
+            "candidate": candidate,
+            "candidate_source": source,
+            "rounds": len(points),
+            "lower_is_better": lower,
+            "delta_vs_best": round(delta, 4),
+            "status": "REGRESSED" if regressed else "ok",
+        }
+        report["series"][key] = row
+        if regressed:
+            report["regressions"].append(key)
+    for key in sorted(set(fresh) - seen_fresh):
+        report["series"][key] = {
+            "best": None,
+            "candidate": fresh[key],
+            "candidate_source": "fresh",
+            "rounds": 0,
+            "status": "no-baseline",
+        }
+    report["ok"] = not report["regressions"]
+    return report
+
+
+def load_fresh(path: Path) -> dict[str, float]:
+    """Flatten a fresh bench document into {series key: value}."""
+    obj = json.loads(path.read_text())
+    out: dict[str, float] = {}
+    for entry in _entries(obj):
+        for fld in _FIELDS:
+            val = entry.get(fld)
+            if not isinstance(val, (int, float)) or isinstance(val, bool):
+                continue
+            if _lower_is_better(fld, entry) is None:
+                continue
+            out[f"{entry['metric']} :: {fld}"] = float(val)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--history",
+        default=None,
+        help="directory holding the recorded BENCH_r*/LADDER_r* rounds "
+        "(default: the repo root this script lives in)",
+    )
+    ap.add_argument(
+        "--glob",
+        action="append",
+        default=None,
+        help="history filename glob(s); default: BENCH_r*.json and "
+        "LADDER_r*.json",
+    )
+    ap.add_argument(
+        "--fresh",
+        default=None,
+        help="JSON file with a fresh bench entry (bench.py output) to "
+        "gate against the recorded best",
+    )
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.15,
+        help="relative regression tolerance vs the best recorded value "
+        "(default 0.15 = 15%%)",
+    )
+    ap.add_argument("--out", default="SENTINEL.json", help="report path")
+    args = ap.parse_args(argv)
+
+    root = Path(args.history) if args.history else Path(__file__).resolve().parent.parent
+    patterns = args.glob or ["BENCH_r*.json", "LADDER_r*.json"]
+    paths = [
+        Path(p) for pat in patterns for p in globlib.glob(str(root / pat))
+    ]
+    if not paths:
+        print(f"perf_sentinel: no history matches {patterns} under {root}", file=sys.stderr)
+        return 2
+
+    series = collect_series(paths)
+    fresh = None
+    if args.fresh:
+        try:
+            fresh = load_fresh(Path(args.fresh))
+        except (OSError, json.JSONDecodeError, KeyError) as exc:
+            print(f"perf_sentinel: bad --fresh file: {exc}", file=sys.stderr)
+            return 2
+
+    report = evaluate(series, fresh, args.threshold)
+    report["threshold"] = args.threshold
+    report["history_files"] = sorted(p.name for p in paths)
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+
+    for key, row in report["series"].items():
+        print(f"  [{row['status']:>11}] {key}: best={row['best']} "
+              f"candidate={row['candidate']} ({row['candidate_source']})")
+    if report["regressions"]:
+        print(
+            f"perf_sentinel: {len(report['regressions'])} metric(s) regressed "
+            f">{args.threshold:.0%} vs the best recorded value:",
+            file=sys.stderr,
+        )
+        for key in report["regressions"]:
+            row = report["series"][key]
+            print(
+                f"  {key}: best {row['best']} -> {row['candidate']} "
+                f"(+{row['delta_vs_best']:.1%}, {row['candidate_source']})",
+                file=sys.stderr,
+            )
+        return 1
+    print(f"perf_sentinel: OK — {len(report['series'])} series within "
+          f"{args.threshold:.0%} of their best ({args.out} written)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
