@@ -1,0 +1,491 @@
+"""Ingest-storm load generator for the admission plane (ISSUE 7).
+
+Drives ``protocol_tpu.ingest.IngestPlane`` with a pre-signed corpus
+(signed by a multi-process generator pool) under four adversarial
+mixes and reports the two headline numbers ROADMAP item 2 asks for:
+**sustained accepted sigs/s** and **p99 admission latency** — measured
+while a churned multi-epoch convergence loop (the real
+``EpochPipeline``) runs concurrently in the same process, exactly the
+contention the admission tier exists to survive.
+
+Mixes:
+
+- **honest** — unique, validly-signed attestations from whitelisted
+  senders; run twice: single-process inline verify (the pre-ISSUE-7
+  baseline) and with the verify worker pool (``--workers``);
+- **replay** — the honest corpus submitted twice; every second copy
+  must die in the dedup cache (``accepted_replays`` must be 0);
+- **bad-sig** — corrupted signatures; every one must be rejected by
+  the verify tier (``accepted_bad_sigs`` must be 0);
+- **hot-sender** — one sender hammering far above the token rate with
+  the whitelist off; the rate limiter + spam score must shed them.
+
+Results land as ``INGEST_r<N>.json`` (``--out``), which
+``tools/perf_sentinel.py`` folds into its regression series
+(``sigs_per_s`` up, ``p99_admission_ms`` down).  ``--smoke`` is the CI
+shape (seconds, not minutes); ``--fail-on-shed`` makes honest-mix shed
+or any accepted replay/bad-sig a non-zero exit (the CI gate).
+
+NOTE on scaling: worker-pool speedup is a *core-count* story.  On a
+1-core container the 4-worker number lands ~1x the single-process
+baseline (there is only one core to share); the recorded ``cores``
+field says which regime a round measured.  PERF.md §13 tracks both.
+
+Run::
+
+    JAX_PLATFORMS=cpu python bench/ingest_storm.py --workers 4 --out INGEST_r01.json
+    JAX_PLATFORMS=cpu python bench/ingest_storm.py --smoke --fail-on-shed --out INGEST_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ProcessPoolExecutor
+from multiprocessing import get_context
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def _scores_row(i: int) -> list[int]:
+    """Unique, conservation-respecting score vector #i (sums to the
+    SCALE=1000 the structural gate enforces; all entries positive)."""
+    d1 = i % 200
+    d2 = (i // 200) % 200
+    return [200 + d1 - d2, 200 - d1, 200 + d2, 200, 200]
+
+
+def _sign_range(pairs: list[tuple[str, str]], start: int, count: int) -> list[tuple]:
+    """Generator-pool worker: sign ``count`` unique attestations
+    (sender round-robins the group).  Returns flat int tuples —
+    (sender_idx, i, rx, ry, s) — reassembled by the parent."""
+    from protocol_tpu.crypto import calculate_message_hash
+    from protocol_tpu.crypto.eddsa import sign
+    from protocol_tpu.node.bootstrap import keyset_from_raw
+
+    sks, pks = keyset_from_raw(pairs)
+    out = []
+    for i in range(start, start + count):
+        sender = i % len(pks)
+        row = _scores_row(i)
+        _, msgs = calculate_message_hash(pks, [row])
+        sig = sign(sks[sender], pks[sender], msgs[0])
+        out.append((sender, i, sig.big_r.x, sig.big_r.y, sig.s))
+    return out
+
+
+def _build_corpus(count: int, gen_workers: int) -> list:
+    """Pre-sign the honest corpus with a multi-process generator pool
+    (signing is ~5 ms of Python per attestation — the generator, not
+    the plane, would be the bottleneck without the pool)."""
+    from protocol_tpu.crypto.babyjubjub import Point
+    from protocol_tpu.crypto.eddsa import Signature
+    from protocol_tpu.node.attestation import Attestation
+    from protocol_tpu.node.bootstrap import FIXED_SET, keyset_from_raw
+
+    _, pks = keyset_from_raw(FIXED_SET)
+    chunk = max(1, (count + gen_workers - 1) // gen_workers)
+    ranges = [
+        (start, min(chunk, count - start)) for start in range(0, count, chunk)
+    ]
+    if gen_workers > 1 and len(ranges) > 1:
+        with ProcessPoolExecutor(
+            max_workers=gen_workers, mp_context=get_context("spawn")
+        ) as pool:
+            parts = list(
+                pool.map(
+                    _sign_range,
+                    [list(FIXED_SET)] * len(ranges),
+                    [r[0] for r in ranges],
+                    [r[1] for r in ranges],
+                )
+            )
+    else:
+        parts = [_sign_range(list(FIXED_SET), s, c) for s, c in ranges]
+    corpus = []
+    for part in parts:
+        for sender, i, rx, ry, s in part:
+            corpus.append(
+                Attestation(
+                    sig=Signature(Point(rx, ry), s),
+                    pk=pks[sender],
+                    neighbours=list(pks),
+                    scores=_scores_row(i),
+                )
+            )
+    return corpus
+
+
+class _StormStats:
+    """Per-run latency/throughput collector (callback-driven)."""
+
+    def __init__(self) -> None:
+        self.latencies_ms: list[float] = []
+        self.resolved = 0
+        self._lock = threading.Lock()
+
+    def callback(self, submitted_at: float):
+        def _done(_future) -> None:
+            dt = (time.perf_counter() - submitted_at) * 1e3
+            with self._lock:
+                self.latencies_ms.append(dt)
+                self.resolved += 1
+
+        return _done
+
+    def pct(self, q: float) -> float:
+        return float(np.percentile(self.latencies_ms, q)) if self.latencies_ms else 0.0
+
+
+def _run_storm(plane, corpus, *, nonce_base: int | None = None, pace: int = 512):
+    """Submit the whole corpus as fast as the plane admits, pacing on
+    outstanding futures so an honest run never floods its own bounded
+    queue into shedding.  Returns (stats, wall_seconds)."""
+    stats = _StormStats()
+    t0 = time.perf_counter()
+    for k, att in enumerate(corpus):
+        while k - stats.resolved > pace:
+            time.sleep(0.0005)
+        submitted = time.perf_counter()
+        nonce = None if nonce_base is None else nonce_base + k
+        plane.submit(att, nonce=nonce).add_done_callback(stats.callback(submitted))
+    plane.drain(timeout=600)
+    return stats, time.perf_counter() - t0
+
+
+def _fresh_plane(manager, *, workers: int, whitelist: bool = True,
+                 rate: float = 1e9, burst: float = 1e9, queue_max: int = 1024,
+                 batch_size: int = 64):
+    from protocol_tpu.ingest import IngestPlane, IngestPlaneConfig
+    from protocol_tpu.ingest.ratelimit import RateLimitConfig
+
+    wl = (
+        frozenset((pk.point.x, pk.point.y) for pk in manager._group_pks)
+        if whitelist
+        else frozenset()
+    )
+    return IngestPlane(
+        manager,
+        IngestPlaneConfig(
+            workers=workers,
+            batch_size=batch_size,
+            submit_queue_max=queue_max,
+            rate=RateLimitConfig(rate=rate, burst=burst, whitelist=wl),
+        ),
+    ).start()
+
+
+def _fresh_manager():
+    from protocol_tpu.node.manager import Manager, ManagerConfig
+
+    return Manager(ManagerConfig(prover="commitment"))
+
+
+def _epoch_loop_thread(peers: int, edges: int, epochs: int, result: dict):
+    """The concurrent churned convergence loop: the real EpochPipeline
+    over a synthetic open graph (mirrors tools/epoch_pipe.py — peer
+    hashes are row ids so warm-start/delta plumbing runs for real)."""
+    from protocol_tpu.models.graphs import scale_free
+    from protocol_tpu.node.epoch import Epoch
+    from protocol_tpu.node.manager import Manager, ManagerConfig
+    from protocol_tpu.node.pipeline import EpochPipeline
+    from protocol_tpu.trust.graph import TrustGraph
+
+    class _ChurnManager(Manager):
+        def __init__(self, g):
+            super().__init__(
+                ManagerConfig(
+                    backend="tpu-windowed",
+                    prover="commitment",
+                    plan_delta_max_churn=0.25,
+                )
+            )
+            self._graph = g
+            self._rng = np.random.default_rng(23)
+
+        def churn(self, fraction: float) -> int:
+            g = self._graph
+            k = max(1, int(g.nnz * fraction))
+            idx = self._rng.choice(g.nnz, k, replace=False)
+            dst = g.dst.copy()
+            dst[idx] = self._rng.integers(0, g.n, k)
+            while (bad := dst[idx] == g.src[idx]).any():
+                dst[idx[bad]] = self._rng.integers(0, g.n, int(bad.sum()))
+            self._graph = TrustGraph(g.n, g.src, dst, g.weight, g.pre_trusted)
+            self._dirty_hashes.update(int(s) for s in np.unique(g.src[idx]))
+            return k
+
+        def build_graph(self):
+            self._id_order = list(range(self._graph.n))
+            return self._graph
+
+    manager = _ChurnManager(scale_free(peers, edges, seed=7))
+    per_epoch = []
+    try:
+        with EpochPipeline(manager, alpha=0.1, tol=1e-6, max_iter=80) as pipe:
+            for k in range(epochs):
+                if k:
+                    manager.churn(0.01)
+                t0 = time.perf_counter()
+                pipe.submit(Epoch(k))
+                landed = pipe.drain(timeout=600)
+                outcome = pipe.outcomes.get(k)
+                per_epoch.append(
+                    {
+                        "epoch": k,
+                        "seconds": round(time.perf_counter() - t0, 4),
+                        "landed": bool(landed and outcome and outcome.error is None),
+                    }
+                )
+            result["coalesced"] = pipe.coalesced
+    except Exception as exc:  # noqa: BLE001 - report, don't kill the bench
+        result["error"] = repr(exc)
+    result["per_epoch"] = per_epoch
+    result["all_landed"] = all(e["landed"] for e in per_epoch) and len(
+        per_epoch
+    ) == epochs
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--count", type=int, default=2000, help="honest corpus size")
+    ap.add_argument("--workers", type=int, default=4, help="verify worker processes")
+    ap.add_argument("--gen-workers", type=int, default=4, help="signer processes")
+    ap.add_argument("--epochs", type=int, default=3, help="concurrent churned epochs")
+    ap.add_argument("--peers", type=int, default=20_000)
+    ap.add_argument("--edges", type=int, default=120_000)
+    ap.add_argument("--mix", default="all", choices=["all", "honest"])
+    ap.add_argument("--smoke", action="store_true", help="CI shape: seconds, not minutes")
+    ap.add_argument("--fail-on-shed", action="store_true",
+                    help="exit 1 on honest-mix shed or any accepted replay/bad-sig")
+    ap.add_argument("--out", default="INGEST_smoke.json")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.count = min(args.count, 150)
+        args.workers = min(args.workers, 2)
+        args.gen_workers = min(args.gen_workers, 2)
+        args.epochs = min(args.epochs, 2)
+        args.peers, args.edges = 4000, 24_000
+
+    from protocol_tpu.obs.metrics import EPOCH_TICKS_DROPPED
+
+    print(f"ingest_storm: signing {args.count}-attestation corpus "
+          f"({args.gen_workers} generator processes)...")
+    t0 = time.perf_counter()
+    corpus = _build_corpus(args.count, args.gen_workers)
+    print(f"ingest_storm: corpus ready in {time.perf_counter() - t0:.1f}s")
+
+    report: dict = {
+        "n": 1,
+        "bench": "ingest_storm",
+        "cores": os.cpu_count(),
+        "config": {
+            "count": args.count,
+            "workers": args.workers,
+            "epochs": args.epochs,
+            "smoke": bool(args.smoke),
+        },
+        "entries": [],
+    }
+    shape = f"{args.count} sigs"
+    failures: list[str] = []
+
+    # -- honest, single-process baseline (workers=0, no epoch loop) ----
+    manager = _fresh_manager()
+    with _fresh_plane(manager, workers=0) as plane:
+        stats, wall = _run_storm(plane, corpus)
+        baseline = plane.accepted / wall if wall > 0 else 0.0
+        report["entries"].append(
+            {
+                "metric": f"ingest-storm accepted sigs/s (honest, {shape}, single-process)",
+                "sigs_per_s": round(baseline, 1),
+                "p99_admission_ms": round(stats.pct(99), 2),
+                "p50_admission_ms": round(stats.pct(50), 2),
+                "accepted": plane.accepted,
+                "shed": plane.shed,
+                "rejections": plane.rejections,
+            }
+        )
+        if plane.shed or plane.rejections:
+            failures.append(f"single-process honest mix shed/rejected: {plane.stats()}")
+    print(f"ingest_storm: single-process honest {baseline:.0f} accepted sigs/s")
+
+    # -- honest, worker pool alone (pure worker-scaling measure) -------
+    manager = _fresh_manager()
+    with _fresh_plane(manager, workers=args.workers) as plane:
+        warm = corpus[0]
+        plane.pool.verify(plane._pks_hash, [
+            (warm.sig.big_r.x, warm.sig.big_r.y, warm.sig.s,
+             warm.pk.point.x, warm.pk.point.y, tuple(warm.scores))
+        ])
+        stats, wall = _run_storm(plane, corpus)
+        pooled = plane.accepted / wall if wall > 0 else 0.0
+        report["entries"].append(
+            {
+                "metric": f"ingest-storm accepted sigs/s (honest, {shape}, "
+                          f"{args.workers} workers)",
+                "sigs_per_s": round(pooled, 1),
+                "p99_admission_ms": round(stats.pct(99), 2),
+                "p50_admission_ms": round(stats.pct(50), 2),
+                "accepted": plane.accepted,
+                "shed": plane.shed,
+                "rejections": plane.rejections,
+            }
+        )
+        if plane.shed or plane.rejections:
+            failures.append(f"worker-pool honest mix shed/rejected: {plane.stats()}")
+    report["speedup_vs_single_process"] = (
+        round(pooled / baseline, 2) if baseline else None
+    )
+    print(
+        f"ingest_storm: {args.workers}-worker honest {pooled:.0f} accepted sigs/s "
+        f"({report['speedup_vs_single_process']}x vs single-process on "
+        f"{report['cores']} core(s))"
+    )
+
+    # -- honest headline: worker pool + concurrent churned epoch loop --
+    dropped0 = EPOCH_TICKS_DROPPED.value()
+    epoch_result: dict = {}
+    epoch_thread = threading.Thread(
+        target=_epoch_loop_thread,
+        args=(args.peers, args.edges, args.epochs, epoch_result),
+        daemon=True,
+    )
+    manager = _fresh_manager()
+    with _fresh_plane(manager, workers=args.workers) as plane:
+        # Warm the pool (spawn + per-worker crypto import) off the
+        # clock: the steady-state number should not bill process
+        # startup against admission latency.
+        warm = corpus[0]
+        plane.pool.verify(
+            plane._pks_hash,
+            [
+                (
+                    warm.sig.big_r.x,
+                    warm.sig.big_r.y,
+                    warm.sig.s,
+                    warm.pk.point.x,
+                    warm.pk.point.y,
+                    tuple(warm.scores),
+                )
+            ]
+            * max(1, args.workers),
+        )
+        epoch_thread.start()
+        stats, wall = _run_storm(plane, corpus)
+        headline = plane.accepted / wall if wall > 0 else 0.0
+        entry = {
+            "metric": f"ingest-storm accepted sigs/s (honest, {shape}, "
+                      f"{args.workers} workers + churned epoch loop)",
+            "sigs_per_s": round(headline, 1),
+            "p99_admission_ms": round(stats.pct(99), 2),
+            "p50_admission_ms": round(stats.pct(50), 2),
+            "accepted": plane.accepted,
+            "shed": plane.shed,
+            "rejections": plane.rejections,
+        }
+        report["entries"].append(entry)
+        if plane.shed or plane.rejections:
+            failures.append(
+                f"honest mix under epoch loop shed/rejected: {plane.stats()}"
+            )
+        epoch_thread.join(timeout=600)
+    report["throughput_retained_under_epoch_loop"] = (
+        round(headline / pooled, 2) if pooled else None
+    )
+    epoch_result["dropped_ticks"] = EPOCH_TICKS_DROPPED.value() - dropped0
+    report["epoch_loop"] = epoch_result
+    if not epoch_result.get("all_landed"):
+        failures.append(f"concurrent epoch loop did not land every epoch: {epoch_result}")
+    if epoch_result["dropped_ticks"]:
+        failures.append(f"epoch loop dropped {epoch_result['dropped_ticks']} tick(s)")
+    print(
+        f"ingest_storm: under churned epoch loop {headline:.0f} accepted sigs/s "
+        f"(p99 {stats.pct(99):.1f} ms, "
+        f"{report['throughput_retained_under_epoch_loop']}x of the uncontended "
+        f"pool); epoch loop {'ok' if epoch_result.get('all_landed') else 'FAILED'}"
+    )
+
+    if args.mix == "all":
+        adversarial: dict = {}
+        # Replay: the corpus twice; second copies must all dedup out.
+        manager = _fresh_manager()
+        with _fresh_plane(manager, workers=0) as plane:
+            _run_storm(plane, corpus)
+            first_accepted = plane.accepted
+            _run_storm(plane, corpus)
+            adversarial["replay"] = {
+                "accepted_first_pass": first_accepted,
+                "accepted_replays": plane.accepted - first_accepted,
+                "duplicates_rejected": plane.rejections.get("duplicate", 0),
+            }
+            if plane.accepted != first_accepted:
+                failures.append(f"replays accepted: {adversarial['replay']}")
+
+        # Bad signatures: corrupt s; every one must be rejected.
+        from protocol_tpu.crypto.eddsa import Signature
+        from protocol_tpu.node.attestation import Attestation
+
+        bad_corpus = [
+            Attestation(
+                sig=Signature(a.sig.big_r, a.sig.s + 1),
+                pk=a.pk,
+                neighbours=a.neighbours,
+                scores=a.scores,
+            )
+            for a in corpus[: max(50, args.count // 4)]
+        ]
+        manager = _fresh_manager()
+        with _fresh_plane(manager, workers=0) as plane:
+            _run_storm(plane, bad_corpus)
+            adversarial["bad_sig"] = {
+                "submitted": len(bad_corpus),
+                "accepted_bad_sigs": plane.accepted,
+                "rejected": plane.rejections.get("bad-signature", 0),
+            }
+            if plane.accepted:
+                failures.append(f"bad signatures accepted: {adversarial['bad_sig']}")
+
+        # Hot sender: whitelist off, tight bucket; the flood must shed
+        # at the rate limiter, not reach the verify tier.
+        hot = [corpus[i] for i in range(0, len(corpus), 5)]  # one sender
+        manager = _fresh_manager()
+        with _fresh_plane(
+            manager, workers=0, whitelist=False, rate=20.0, burst=25.0
+        ) as plane:
+            _run_storm(plane, hot)
+            adversarial["hot_sender"] = {
+                "submitted": len(hot),
+                "accepted": plane.accepted,
+                "rate_limited": plane.rejections.get("rate-limited", 0),
+                "spam_score": plane.rejections.get("spam-score", 0),
+            }
+            limited = (
+                adversarial["hot_sender"]["rate_limited"]
+                + adversarial["hot_sender"]["spam_score"]
+            )
+            if len(hot) > 30 and not limited:
+                failures.append(f"hot sender never limited: {adversarial['hot_sender']}")
+        report["adversarial"] = adversarial
+        print(f"ingest_storm: adversarial mixes {json.dumps(adversarial)}")
+
+    report["failures"] = failures
+    Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(f"ingest_storm: report at {args.out}")
+    if failures and args.fail_on_shed:
+        for f in failures:
+            print(f"ingest_storm: FAIL — {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

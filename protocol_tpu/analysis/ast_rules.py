@@ -62,6 +62,20 @@ Pass 5 — the flight-recorder boundary rule (ISSUE 6):
   rewrite would smuggle a host sync into the hot loop.  Journal
   writes happen at host boundaries (epoch tick, ingest, pipeline),
   exactly like spans and metrics.
+
+Pass 6 — the admission-plane boundary rule (ISSUE 7):
+
+- ``blocking-ingest-in-epoch-loop`` (error): synchronous signature
+  verification (``verify_sig``/``eddsa_verify_batch``/
+  ``verify_batch`` or the Manager ingest entry points
+  ``add_attestation``/``add_attestations_bulk``), or a potentially
+  unbounded blocking queue ``put()`` (no ``block=False``, no
+  ``timeout=``), inside the epoch-loop code paths
+  (``node/epoch.py`` / ``node/pipeline.py``).  Admission work
+  belongs in the ingest plane (``protocol_tpu/ingest/``) behind its
+  bounded queues; a signature check or an unbounded enqueue on the
+  epoch path would re-couple the convergence cadence to ingest load
+  — exactly the contention the admission tier exists to remove.
 """
 
 from __future__ import annotations
@@ -79,6 +93,14 @@ HOT_TREES = ("ops", "trust", "parallel", "node", "obs")
 #: obs instrumentation layer wraps these modules from the outside
 #: (trust/backend.py, node/), never from within.
 KERNEL_TREES = ("ops", "parallel")
+
+#: The epoch loop's critical path: no synchronous signature
+#: verification, no unbounded blocking queue puts (pass 6) — ingest
+#: work stays in the admission plane behind its bounded queues.
+EPOCH_LOOP_FILES = (
+    "protocol_tpu/node/epoch.py",
+    "protocol_tpu/node/pipeline.py",
+)
 
 #: jnp attributes that are plain dtypes/constants, not array factories.
 _JNP_DTYPE_NAMES = frozenset(
@@ -228,6 +250,39 @@ def _is_journal_call(name: str | None) -> bool:
     return "journal" in tail or "flight" in tail or tail == "recorder"
 
 
+#: Synchronous signature-verification entry points (pass 6): the
+#: crypto verifiers and the Manager ingest methods that call them.
+_SYNC_VERIFY_LEAVES = frozenset(
+    {
+        "verify_sig",
+        "eddsa_verify_batch",
+        "verify_batch",
+        "add_attestation",
+        "add_attestations_bulk",
+    }
+)
+
+
+def _is_sync_verify_call(name: str | None) -> bool:
+    return name is not None and name.rsplit(".", 1)[-1] in _SYNC_VERIFY_LEAVES
+
+
+def _is_unbounded_put(node: ast.Call, name: str | None) -> bool:
+    """``<q>.put(item)`` with neither ``block=False`` nor a
+    ``timeout=`` — a potentially unbounded block.  ``put_nowait`` and
+    explicitly-bounded puts pass."""
+    if name is None or name.rsplit(".", 1)[-1] != "put":
+        return False
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if len(node.args) >= 2:  # explicit positional block arg
+        return False
+    for kw in node.keywords:
+        if kw.arg in ("block", "timeout"):
+            return False
+    return True
+
+
 def _is_span_call(name: str | None) -> bool:
     """obs span entry points (``TRACER.span``/``TRACER.epoch`` or any
     ``*.span(...)``) — host boundaries by definition, so inside a
@@ -238,10 +293,17 @@ def _is_span_call(name: str | None) -> bool:
 
 
 class _Visitor(ast.NodeVisitor):
-    def __init__(self, rel_path: str, hot: bool, kernel_tree: bool = False) -> None:
+    def __init__(
+        self,
+        rel_path: str,
+        hot: bool,
+        kernel_tree: bool = False,
+        epoch_loop: bool = False,
+    ) -> None:
         self.rel_path = rel_path
         self.hot = hot
         self.kernel_tree = kernel_tree
+        self.epoch_loop = epoch_loop
         self.jit_depth = 0
         #: Depth inside jit- OR shard_map-decorated functions (pass 3):
         #: shard_map bodies are traced exactly like jit bodies.
@@ -362,6 +424,30 @@ class _Visitor(ast.NodeVisitor):
                 "never from inside ops/ or parallel/",
                 node,
             )
+        if self.epoch_loop:
+            # Pass 6: the epoch loop must never verify signatures or
+            # block on an unbounded enqueue — admission work lives in
+            # the ingest plane behind bounded queues.
+            if _is_sync_verify_call(name):
+                self._emit(
+                    "blocking-ingest-in-epoch-loop",
+                    f"{name}() on an epoch-loop code path: signature "
+                    "verification belongs in the admission plane "
+                    "(protocol_tpu/ingest/), not in node/epoch.py or "
+                    "node/pipeline.py where it re-couples convergence "
+                    "cadence to ingest load",
+                    node,
+                )
+            elif _is_unbounded_put(node, name):
+                self._emit(
+                    "blocking-ingest-in-epoch-loop",
+                    f"{name}() without block=False or timeout= on an "
+                    "epoch-loop code path: an unbounded blocking "
+                    "enqueue can stall the epoch loop indefinitely — "
+                    "use put_nowait (coalescing backpressure) or a "
+                    "bounded timeout",
+                    node,
+                )
         if (
             self.fn_depth == 0
             and self.hot
@@ -447,6 +533,7 @@ def scan_source(source: str, rel_path: str) -> list[Finding]:
         rel_path,
         hot=_is_hot(rel_path),
         kernel_tree=_in_tree(rel_path, KERNEL_TREES),
+        epoch_loop=rel_path in EPOCH_LOOP_FILES,
     )
     visitor.visit(tree)
     return visitor.findings
@@ -470,4 +557,11 @@ def run_ast_pass(root: str | Path | None = None) -> tuple[list[Finding], int]:
     return findings, len(files)
 
 
-__all__ = ["HOT_TREES", "KERNEL_TREES", "run_ast_pass", "scan_file", "scan_source"]
+__all__ = [
+    "EPOCH_LOOP_FILES",
+    "HOT_TREES",
+    "KERNEL_TREES",
+    "run_ast_pass",
+    "scan_file",
+    "scan_source",
+]

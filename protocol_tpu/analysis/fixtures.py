@@ -249,6 +249,30 @@ def _journal_write_in_jit() -> tuple[str, str]:
     return _JOURNAL_IN_JIT_SRC, "protocol_tpu/trust/_fixture_journal_in_jit.py"
 
 
+_BLOCKING_INGEST_SRC = '''\
+import queue
+
+PENDING = queue.Queue(maxsize=4)
+
+
+def device_stage(manager, atts, prepared):
+    # The epoch loop verifying signatures re-couples convergence
+    # cadence to ingest load — admission belongs in the ingest plane.
+    results = manager.add_attestations_bulk(atts)  # VIOLATION: blocking-ingest-in-epoch-loop
+    # An unbounded blocking put can park the epoch loop forever when
+    # the consumer stalls; put_nowait (coalescing) or timeout= are the
+    # sanctioned shapes.
+    PENDING.put(prepared)
+    return results
+'''
+
+
+def _blocking_ingest_in_epoch_loop() -> tuple[str, str]:
+    # The fake path lands on an epoch-loop file so the file-scoped
+    # pass-6 rule applies exactly as it would to the real module.
+    return _BLOCKING_INGEST_SRC, "protocol_tpu/node/pipeline.py"
+
+
 FIXTURES: dict[str, Fixture] = {
     f.name: f
     for f in (
@@ -289,6 +313,11 @@ FIXTURES: dict[str, Fixture] = {
         Fixture(
             "journal-write-in-jit", "journal-write-in-jit",
             _journal_write_in_jit, "journal-write-in-jit",
+            kind="ast",
+        ),
+        Fixture(
+            "blocking-ingest-in-epoch-loop", "blocking-ingest-in-epoch-loop",
+            _blocking_ingest_in_epoch_loop, "blocking-ingest-in-epoch-loop",
             kind="ast",
         ),
     )

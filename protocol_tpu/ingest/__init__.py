@@ -1,0 +1,50 @@
+"""The admission plane: a horizontally scalable ingest tier in front of
+the Manager (ISSUE 7, ROADMAP item 2).
+
+Batch-EdDSA verification tops out around 3.5k sigs/s in one process
+(PERF.md §4), sharing cores and the GIL with the epoch loop — so
+"millions of users" dies at the front door, not in the matvec.  This
+package moves admission off the epoch loop's process and in front of
+``Manager.add_attestations_bulk``:
+
+- :mod:`~protocol_tpu.ingest.dedup` — a sharded dedup/nonce cache
+  (per-sender monotonic nonces + recent-message-hash generations,
+  bounded memory, epoch-aligned eviction) that rejects replays
+  *before* paying for a signature check;
+- :mod:`~protocol_tpu.ingest.ratelimit` — per-sender token buckets
+  plus a burst/rejection-history spam score, with a pre-trust-set
+  whitelist bypass;
+- :mod:`~protocol_tpu.ingest.workers` — the multi-process
+  signature-verification pool: spawned workers each owning a native
+  batch-EdDSA verifier (and the batched Poseidon message hash), fed
+  fixed-size batches, respawned on crash with in-flight batches
+  retried or rejected — never silently dropped;
+- :mod:`~protocol_tpu.ingest.plane` — the pipeline tying them
+  together behind bounded queues (HTTP intake → admission → verify →
+  manager apply) with backpressure as first-class state: queue-depth
+  gauges, shed counters, journal events, and a 429-style shed verdict
+  the node maps onto the HTTP response.
+
+Every rejection flows through the existing
+:class:`~protocol_tpu.node.manager.IngestResult` reason plumbing and
+the ``eigentrust_attestations_rejected_total`` reason labels, so the
+admission tier widens the front door without forking the ingest
+accounting.  ``bench/ingest_storm.py`` is the load generator; graftlint
+pass 6 (``blocking-ingest-in-epoch-loop``) pins the converse — the
+epoch loop itself must never verify signatures or block on an
+unbounded queue.
+"""
+
+from .dedup import ShardedDedupCache
+from .plane import IngestPlane, IngestPlaneConfig
+from .ratelimit import AdmissionPolicy, RateLimitConfig
+from .workers import VerifyPool
+
+__all__ = [
+    "AdmissionPolicy",
+    "IngestPlane",
+    "IngestPlaneConfig",
+    "RateLimitConfig",
+    "ShardedDedupCache",
+    "VerifyPool",
+]

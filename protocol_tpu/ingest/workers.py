@@ -1,0 +1,161 @@
+"""Multi-process signature-verification workers.
+
+One process tops out around 3.5k verified sigs/s (PERF.md §4) and — far
+worse — shares the GIL and the core budget with the epoch loop.  The
+pool here moves the expensive half of admission (Poseidon message
+hashing + batch EdDSA) into spawned worker processes, each owning its
+own native runtime (``crypto.native`` loads per process; the
+initializer pins ``OMP_NUM_THREADS=1`` so W workers are W cores, not
+W×threads oversubscription).
+
+Work items are flat integer tuples — no protocol objects cross the
+process boundary, so a worker's import footprint is just the pure
+crypto tree — and every batch result is per-item booleans in submit
+order.  Worker death is a first-class outcome: the pool rebuilds the
+executor and the caller's in-flight batch is retried up to
+``max_retries`` times, after which :class:`VerifyCrashed` carries the
+batch out to be *rejected with a reason code*, never silently dropped.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import BrokenExecutor, ProcessPoolExecutor
+from multiprocessing import get_context
+
+from ..obs import metrics as obs_metrics
+from ..obs.journal import JOURNAL
+
+#: Chaos hook for crash-recovery tests and the ingest-storm bench's
+#: worker-crash mix: a work item equal to this string hard-kills the
+#: worker mid-batch (``os._exit``), exactly like an OOM kill would.
+CRASH_MARKER = "__crash-worker__"
+
+#: (sig.R.x, sig.R.y, sig.s, pk.x, pk.y, scores tuple) — everything the
+#: worker needs to bind and verify one attestation signature.
+WorkItem = tuple[int, int, int, int, int, tuple[int, ...]]
+
+
+def _worker_init() -> None:
+    """Runs in each spawned worker before any batch: pin the native
+    runtime to one OpenMP thread so the pool scales by process, and
+    pre-load the crypto tree off the critical path."""
+    os.environ["OMP_NUM_THREADS"] = "1"
+    from ..crypto import native as cnative
+
+    cnative.available()
+
+
+def verify_batch(pks_hash: int, items: list) -> list[bool]:
+    """Hash + verify one batch (runs inside a worker, or inline for
+    ``workers=0``): batched Poseidon message hashes for the shared
+    ``pks_hash``, then one native batch-EdDSA call (pure-Python
+    fallback when the runtime is unavailable)."""
+    from ..crypto import message_hash_batch
+    from ..crypto import native as cnative
+    from ..crypto.babyjubjub import Point
+    from ..crypto.eddsa import PublicKey, Signature, verify as verify_sig
+
+    for item in items:
+        if item == CRASH_MARKER:
+            os._exit(1)
+    msgs = message_hash_batch(pks_hash, [list(it[5]) for it in items])
+    if cnative.available():
+        ok = cnative.eddsa_verify_batch(
+            [it[0] for it in items],
+            [it[1] for it in items],
+            [it[2] for it in items],
+            [it[3] for it in items],
+            [it[4] for it in items],
+            msgs,
+        )
+        return [bool(x) for x in ok]
+    return [
+        verify_sig(
+            Signature.new(it[0], it[1], it[2]), PublicKey(Point(it[3], it[4])), m
+        )
+        for it, m in zip(items, msgs)
+    ]
+
+
+class VerifyCrashed(RuntimeError):
+    """A batch's worker died ``max_retries + 1`` times; the caller must
+    reject the batch's items with a distinct reason code."""
+
+
+class VerifyPool:
+    """Process pool façade with crash recovery.
+
+    ``workers=0`` verifies inline on the calling thread (no processes —
+    the single-node default and the pre-ISSUE-7 behavior); ``workers>0``
+    spawns that many verifier processes.  :meth:`verify` blocks until
+    the batch's verdicts are in, so the plane runs one dispatcher
+    thread per worker to keep all processes fed.
+    """
+
+    def __init__(self, workers: int = 0, *, max_retries: int = 1):
+        self.workers = int(workers)
+        self.max_retries = int(max_retries)
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._executor: ProcessPoolExecutor | None = None
+        if self.workers > 0:
+            self._executor = self._make()
+
+    def _make(self) -> ProcessPoolExecutor:
+        return ProcessPoolExecutor(
+            max_workers=self.workers,
+            mp_context=get_context("spawn"),
+            initializer=_worker_init,
+        )
+
+    def _snapshot(self) -> tuple[int, ProcessPoolExecutor | None]:
+        with self._lock:
+            return self._generation, self._executor
+
+    def _restart(self, generation: int) -> None:
+        """Rebuild the executor once per crash: concurrent batches that
+        all observed the same broken generation race here, and only the
+        first replaces it."""
+        with self._lock:
+            if self._generation != generation or self._executor is None:
+                return
+            old = self._executor
+            self._executor = self._make()
+            self._generation += 1
+        old.shutdown(wait=False, cancel_futures=True)
+        obs_metrics.INGEST_WORKER_RESTARTS.inc()
+        JOURNAL.record("anomaly", what="ingest-worker-crashed", generation=generation)
+
+    def verify(self, pks_hash: int, items: list) -> list[bool]:
+        """Blocking batch verdict with crash retry; raises
+        :class:`VerifyCrashed` when the batch outlives its retries."""
+        attempts = 0
+        while True:
+            generation, executor = self._snapshot()
+            try:
+                if executor is None:
+                    return verify_batch(pks_hash, items)
+                return executor.submit(verify_batch, pks_hash, items).result()
+            except (BrokenExecutor, RuntimeError) as exc:
+                # RuntimeError covers submit() on a shutdown executor
+                # racing close(); treat it like a crash for retry
+                # accounting so items are never silently dropped.
+                self._restart(generation)
+                attempts += 1
+                if attempts > self.max_retries:
+                    obs_metrics.INGEST_VERIFY_BATCHES.inc(outcome="failed")
+                    raise VerifyCrashed(
+                        f"verify batch of {len(items)} died {attempts} time(s)"
+                    ) from exc
+                obs_metrics.INGEST_VERIFY_BATCHES.inc(outcome="retried")
+
+    def close(self) -> None:
+        with self._lock:
+            executor, self._executor = self._executor, None
+        if executor is not None:
+            executor.shutdown(wait=False, cancel_futures=True)
+
+
+__all__ = ["CRASH_MARKER", "VerifyCrashed", "VerifyPool", "WorkItem", "verify_batch"]

@@ -39,6 +39,27 @@ class ProtocolConfig:
     #: Dirty-row fraction above which the windowed plan cache rebuilds
     #: instead of delta-updating (ManagerConfig.plan_delta_max_churn).
     plan_delta_max_churn: float = 0.05
+    #: Admission plane (protocol_tpu/ingest/): bounded-queue intake +
+    #: sharded dedup/nonce cache + per-sender rate limits in front of
+    #: the Manager, serving POST /attestation with 429 shed semantics.
+    #: On by default; ``false`` restores direct Manager ingest.
+    ingest_plane: bool = True
+    #: Verify worker processes (0 = verify inline, no pool): each
+    #: spawned worker owns a native batch-EdDSA verifier pinned to one
+    #: OMP thread, so admission scales across cores and off the epoch
+    #: loop's GIL.
+    ingest_workers: int = 0
+    #: Signatures per verify batch.
+    ingest_batch_size: int = 64
+    #: Submit-queue bound; beyond it, POST /attestation sheds with 429.
+    ingest_queue_max: int = 1024
+    #: Per-sender token-bucket refill (attestations/second) and burst
+    #: capacity for non-whitelisted senders.
+    ingest_rate_rps: float = 50.0
+    ingest_rate_burst: float = 200.0
+    #: Exempt the pre-trust set from rate/spam gates (dedup still
+    #: applies to everyone).
+    ingest_whitelist_pretrusted: bool = True
     #: "plonk" (real KZG SNARK per epoch, the reference's behavior) or
     #: "commitment" (fast Poseidon binding).
     prover: str = "plonk"
@@ -84,6 +105,19 @@ class ProtocolConfig:
         cfg.warm_start = bool(obj.get("warm_start", cfg.warm_start))
         cfg.plan_delta_max_churn = float(
             obj.get("plan_delta_max_churn", cfg.plan_delta_max_churn)
+        )
+        cfg.ingest_plane = bool(obj.get("ingest_plane", cfg.ingest_plane))
+        cfg.ingest_workers = int(obj.get("ingest_workers", cfg.ingest_workers))
+        cfg.ingest_batch_size = int(
+            obj.get("ingest_batch_size", cfg.ingest_batch_size)
+        )
+        cfg.ingest_queue_max = int(obj.get("ingest_queue_max", cfg.ingest_queue_max))
+        cfg.ingest_rate_rps = float(obj.get("ingest_rate_rps", cfg.ingest_rate_rps))
+        cfg.ingest_rate_burst = float(
+            obj.get("ingest_rate_burst", cfg.ingest_rate_burst)
+        )
+        cfg.ingest_whitelist_pretrusted = bool(
+            obj.get("ingest_whitelist_pretrusted", cfg.ingest_whitelist_pretrusted)
         )
         cfg.prover = obj.get("prover", cfg.prover)
         cfg.srs_path = obj.get("srs_path", cfg.srs_path)

@@ -21,7 +21,7 @@ from dataclasses import dataclass, field as dc_field
 import numpy as np
 
 from ..analysis.budget import KERNEL_INVARIANTS, NON_JAX_BACKENDS
-from ..crypto import calculate_message_hash, field
+from ..crypto import calculate_message_hash, group_pks_hash, message_hash_batch
 from ..crypto.eddsa import PublicKey, sign, verify as verify_sig
 from ..obs import TRACER
 from ..obs import metrics as obs_metrics
@@ -160,6 +160,10 @@ class Manager:
         self._id_order: list[int] = []
         _, self._group_pks = keyset_from_raw(self.config.fixed_set)
         self._group_hashes = [pk.hash() for pk in self._group_pks]
+        #: The pk-sponge half of the protocol message hash — shared by
+        #: every attestation against this group (hash it once, not once
+        #: per signature; the admission plane's workers get it too).
+        self._group_pks_hash = group_pks_hash(self._group_pks)
         # Poseidon pk-hash memo: hashing is 68 field-level rounds of
         # pure Python; never recompute for a seen key.
         self._hash_cache: dict[PublicKey, int] = dict(
@@ -221,52 +225,27 @@ class Manager:
             )
         return None
 
-    def add_attestation(self, att: Attestation) -> None:
+    def add_attestation(self, att: Attestation) -> IngestResult:
         """Validate and cache one attestation (manager/mod.rs:95-138):
         the neighbour list must match the group, the sender must be a
         member, and the signature must verify over the protocol message
-        hash."""
-        error = self._structural_error(att)
-        if error is not None:
-            obs_metrics.ATTESTATIONS_REJECTED.inc(reason=error[0])
-            JOURNAL.record("ingest-reject", reason=error[0])
-            raise EigenError.invalid_attestation(error[1])
+        hash.  Returns the same per-item :class:`IngestResult` as the
+        bulk path (and IS the bulk path at batch size 1), so single-item
+        and bulk ingestion report rejections uniformly instead of this
+        path raising where the other returns."""
+        return self.add_attestations_bulk([att])[0]
 
-        _, message_hashes = calculate_message_hash(att.neighbours, [att.scores])
-        if not self._verify_sig(att, message_hashes[0]):
-            obs_metrics.ATTESTATIONS_REJECTED.inc(reason="bad-signature")
-            JOURNAL.record("ingest-reject", reason="bad-signature")
-            raise EigenError.invalid_attestation("signature verification failed")
-
-        obs_metrics.ATTESTATIONS_ACCEPTED.inc()
+    def apply_verified(self, att: Attestation) -> IngestResult:
+        """Cache an attestation whose structural AND signature checks
+        already passed upstream — the admission plane's apply stage
+        (ingest/plane.py): verification happened in a worker process,
+        so all that's left here is the (memoized) pk hash and two
+        GIL-atomic dict writes."""
         h = self._pk_hash(att.pk)
         self.attestations[h] = att
         self._dirty_hashes.add(h)
-
-    @staticmethod
-    def _verify_sig(att: Attestation, message_hash: int) -> bool:
-        """EdDSA verification, preferring the C++ runtime."""
-        import time
-
-        from ..crypto import native as cnative
-
-        t0 = time.perf_counter()
-        try:
-            if cnative.available():
-                return bool(
-                    cnative.eddsa_verify_batch(
-                        [att.sig.big_r.x],
-                        [att.sig.big_r.y],
-                        [att.sig.s],
-                        [att.pk.point.x],
-                        [att.pk.point.y],
-                        [message_hash],
-                    )[0]
-                )
-            return verify_sig(att.sig, att.pk, message_hash)
-        finally:
-            obs_metrics.SIG_VERIFY_SECONDS.observe(time.perf_counter() - t0)
-            obs_metrics.SIGS_VERIFIED.inc()
+        obs_metrics.ATTESTATIONS_ACCEPTED.inc()
+        return IngestResult(True)
 
     def add_attestations_bulk(self, atts: list[Attestation]) -> list[IngestResult]:
         """High-throughput ingest for event replay: run the shared
@@ -282,15 +261,25 @@ class Manager:
         candidates: list[tuple[int, Attestation, int]] = []
         results: list[IngestResult | None] = [None] * len(atts)
         with TRACER.span("ingest", batch=len(atts)):
+            survivors: list[tuple[int, Attestation]] = []
             for i, att in enumerate(atts):
                 error = self._structural_error(att)
                 if error is None:
-                    _, mh = calculate_message_hash(att.neighbours, [att.scores])
-                    candidates.append((i, att, mh[0]))
+                    survivors.append((i, att))
                 else:
                     results[i] = IngestResult(False, error[0])
                     obs_metrics.ATTESTATIONS_REJECTED.inc(reason=error[0])
                     JOURNAL.record("ingest-reject", reason=error[0])
+            # Every structural survivor attests against THE group, so
+            # the pk-sponge half of the message hash is shared and the
+            # per-row half batches through the native Poseidon runtime
+            # (crypto.message_hash_batch) — ~6x over hashing each
+            # attestation's message separately in Python.
+            if survivors:
+                mhs = message_hash_batch(
+                    self._group_pks_hash, [list(a.scores) for _, a in survivors]
+                )
+                candidates = [(i, a, m) for (i, a), m in zip(survivors, mhs)]
 
             t0 = time.perf_counter()
             if candidates and cnative.available():

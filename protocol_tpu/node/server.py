@@ -35,9 +35,19 @@ log = logging.getLogger("protocol_tpu.node")
 
 BAD_REQUEST = 400
 NOT_FOUND = 404
+TOO_MANY_REQUESTS = 429
 INTERNAL_SERVER_ERROR = 500
 
-_STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found", 500: "Internal Server Error"}
+_STATUS_TEXT = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+}
+
+#: Largest accepted POST body (an attestation payload is a few KiB).
+_MAX_BODY = 1 << 20
 
 
 def _backend_tag(manager: Manager) -> str:
@@ -156,6 +166,11 @@ class Node:
     #: stages of epoch k+1 overlap device converge + proving of epoch
     #: k; None in sequential mode.
     _pipeline: object | None = field(default=None, repr=False)
+    #: Admission plane (config.ingest_plane, on by default): bounded
+    #: intake + sharded dedup + rate limits + the verify worker pool in
+    #: front of the Manager; POST /attestation and the chain-event
+    #: stream both route through it.  None = legacy direct ingest.
+    _ingest: object | None = field(default=None, repr=False)
 
     @classmethod
     def from_config(cls, config: ProtocolConfig) -> "Node":
@@ -177,17 +192,36 @@ class Node:
             if len(parts) < 2:
                 status, body = BAD_REQUEST, "InvalidRequest"
             else:
-                # Drain headers (connection: close semantics, no body
-                # reads), bounded against slow-loris: at most 100 header
-                # lines within one 10s total deadline.
-                async def drain_headers():
+                # Drain headers (connection: close semantics), bounded
+                # against slow-loris: at most 100 header lines within
+                # one 10s total deadline.  content-length is the one
+                # header the ingest POST route needs.
+                async def drain_headers() -> int:
+                    length = 0
                     for _ in range(100):
                         line = await reader.readline()
                         if line in (b"\r\n", b"\n", b""):
-                            return
+                            return length
+                        name, _, value = line.decode("latin1").partition(":")
+                        if name.strip().lower() == "content-length":
+                            try:
+                                length = int(value.strip())
+                            except ValueError:
+                                length = 0
+                    return length
 
-                await asyncio.wait_for(drain_headers(), timeout=10)
-                if parts[1].split("?", 1)[0] == "/aggregate":
+                content_length = await asyncio.wait_for(drain_headers(), timeout=10)
+                if parts[0] == "POST" and parts[1].split("?", 1)[0] == "/attestation":
+                    # Admission-plane intake: bounded body read, then a
+                    # non-blocking submit whose verdict (or 429 shed)
+                    # is awaited without holding the event loop.
+                    payload_in = b""
+                    if 0 < content_length <= _MAX_BODY:
+                        payload_in = await asyncio.wait_for(
+                            reader.readexactly(content_length), timeout=10
+                        )
+                    status, body = await self._handle_ingest_post(parts[1], payload_in)
+                elif parts[1].split("?", 1)[0] == "/aggregate":
                     # Aggregation runs verify_deferred per member plus a
                     # pairing — seconds of crypto that must not stall the
                     # event loop (reference stance: heavy work off-loop,
@@ -213,10 +247,53 @@ class Node:
                 + payload
             )
             await writer.drain()
-        except (asyncio.TimeoutError, ConnectionError) as e:
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError, ConnectionError) as e:
             log.warning("error serving connection: %r", e)
         finally:
             writer.close()
+
+    async def _handle_ingest_post(self, path: str, payload: bytes) -> tuple[int, str]:
+        """POST /attestation[?nonce=N]: decode the wire payload and
+        route it through the admission plane.  Verdict → status: 200
+        accepted, 400 rejected (reason in the body), 429 shed (the
+        submit queue is full — back off and retry).  Without a plane
+        (config.ingest_plane=false) the legacy direct path runs in an
+        executor so signature checks never block the event loop."""
+        from urllib.parse import parse_qs, urlsplit
+
+        from .attestation import AttestationData
+
+        n = self.manager.config.num_neighbours
+        try:
+            qs = parse_qs(urlsplit(path).query)
+            nonce = int(qs["nonce"][0]) if "nonce" in qs else None
+            att = AttestationData.from_bytes(payload, n).to_attestation(n)
+        except (ValueError, KeyError, IndexError):
+            return BAD_REQUEST, json.dumps(
+                {"accepted": False, "reason": "malformed-payload"}
+            )
+        if self._ingest is None:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, self.manager.add_attestation, att
+            )
+        else:
+            from ..ingest.plane import SHED_REASON
+
+            future = self._ingest.submit(att, nonce=nonce, raw=payload)
+            try:
+                result = await asyncio.wait_for(asyncio.wrap_future(future), timeout=30)
+            except asyncio.TimeoutError:
+                return INTERNAL_SERVER_ERROR, json.dumps(
+                    {"accepted": False, "reason": "verdict-timeout"}
+                )
+            if not result.accepted and result.reason == SHED_REASON:
+                return TOO_MANY_REQUESTS, json.dumps(
+                    {"accepted": False, "reason": result.reason}
+                )
+        status = 200 if result.accepted else BAD_REQUEST
+        return status, json.dumps(
+            {"accepted": result.accepted, "reason": result.reason}
+        )
 
     def _epoch_tick(self, epoch: Epoch) -> None:
         """One epoch of work: the fixed-set proof (reference parity) and,
@@ -257,6 +334,10 @@ class Node:
             self._checkpoint_epoch(epoch, scores)
         TELEMETRY.count("epochs")
         obs_metrics.EPOCHS_TOTAL.inc()
+        if self._ingest is not None:
+            # Epoch-aligned dedup eviction: "recent" replays are those
+            # inside the horizon that could still perturb convergence.
+            self._ingest.advance_epoch()
 
     def _checkpoint_epoch(self, epoch: Epoch, scores) -> None:
         """Snapshot the epoch (graph + scores + proof + windowed plan +
@@ -326,6 +407,8 @@ class Node:
             self._checkpoint_epoch(epoch, scores)
         TELEMETRY.count("epochs")
         obs_metrics.EPOCHS_TOTAL.inc()
+        if self._ingest is not None:
+            self._ingest.advance_epoch()
         return result
 
     async def _epoch_loop(self, warm=None):
@@ -398,10 +481,34 @@ class Node:
                     event.val, self.manager.config.num_neighbours
                 )
                 att = att_data.to_attestation(self.manager.config.num_neighbours)
-                self.manager.add_attestation(att)
-                log.info("attestation ingested from %s", event.creator)
+                if self._ingest is not None:
+                    # Non-blocking: the plane owns dedup/rate/verify;
+                    # the verdict lands in a callback so a verify
+                    # backlog never stalls the event stream.
+                    future = self._ingest.submit(att, raw=event.val)
+                    future.add_done_callback(
+                        lambda f, creator=event.creator: self._log_ingest(f, creator)
+                    )
+                else:
+                    result = self.manager.add_attestation(att)
+                    if result.accepted:
+                        log.info("attestation ingested from %s", event.creator)
+                    else:
+                        log.warning(
+                            "rejected attestation event: %s", result.reason
+                        )
             except (EigenError, ValueError) as e:
                 log.warning("rejected attestation event: %s", e)
+
+    @staticmethod
+    def _log_ingest(future, creator: str) -> None:
+        result = future.result()
+        if result.accepted:
+            log.info("attestation ingested from %s", creator)
+        else:
+            log.warning(
+                "rejected attestation event from %s: %s", creator, result.reason
+            )
 
     def _restore_checkpoint(self) -> None:
         """Serve the last checkpointed proof immediately after restart;
@@ -473,6 +580,32 @@ class Node:
         if self.config.checkpoint_dir:
             self._restore_checkpoint()
         self.manager.generate_initial_attestations()
+        if self.config.ingest_plane:
+            from ..ingest import IngestPlane, IngestPlaneConfig
+            from ..ingest.ratelimit import RateLimitConfig
+
+            # The EigenTrust pre-trust set is the spam anchor: its
+            # members bypass rate/spam gates (dedup still applies).
+            whitelist = (
+                frozenset(
+                    (pk.point.x, pk.point.y) for pk in self.manager._group_pks
+                )
+                if self.config.ingest_whitelist_pretrusted
+                else frozenset()
+            )
+            self._ingest = IngestPlane(
+                self.manager,
+                IngestPlaneConfig(
+                    workers=self.config.ingest_workers,
+                    batch_size=self.config.ingest_batch_size,
+                    submit_queue_max=self.config.ingest_queue_max,
+                    rate=RateLimitConfig(
+                        rate=self.config.ingest_rate_rps,
+                        burst=self.config.ingest_rate_burst,
+                        whitelist=whitelist,
+                    ),
+                ),
+            ).start()
         if self.config.epoch_pipeline:
             from .pipeline import EpochPipeline
 
@@ -499,6 +632,13 @@ class Node:
     async def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self._ingest is not None:
+            # Give in-flight admissions a bounded window to land, then
+            # resolve stragglers with reason="shutdown" — off-loop so a
+            # saturated verify tier can't stall stop().
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._ingest.close(drain=True, timeout=5.0)
+            )
         if self._pipeline is not None:
             # Let in-flight device work land (bounded), then stop the
             # worker; run off-loop so a slow prover can't stall stop().

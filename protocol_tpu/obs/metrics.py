@@ -389,6 +389,36 @@ JOURNAL_DROPPED = METRICS.counter(
     "Flight-recorder events evicted from the bounded ring before "
     "reaching disk (journal backpressure)",
 )
+INGEST_QUEUE_DEPTH = METRICS.gauge(
+    "eigentrust_ingest_queue_depth",
+    "Envelopes (stage=submit) or verify batches (stage=verify) waiting "
+    "between admission-plane stages (bounded queues; depth at the bound "
+    "means the next submit sheds)",
+    labelnames=("stage",),
+)
+INGEST_SHED = METRICS.counter(
+    "eigentrust_ingest_shed_total",
+    "Submissions shed by admission-plane backpressure, by stage (a full "
+    "submit queue answers 429 instead of queueing unboundedly)",
+    labelnames=("stage",),
+)
+INGEST_ADMISSION_SECONDS = METRICS.histogram(
+    "eigentrust_ingest_admission_seconds",
+    "Wall-clock from admission-plane submit to the per-item verdict "
+    "(accept or reject), the ingest-storm p99 headline",
+    buckets=TIME_BUCKETS,
+)
+INGEST_VERIFY_BATCHES = METRICS.counter(
+    "eigentrust_ingest_verify_batches_total",
+    "Verify-worker batches by outcome: ok (completed), retried "
+    "(resubmitted after a worker crash), failed (rejected with "
+    "reason=verify-crashed after retries)",
+    labelnames=("outcome",),
+)
+INGEST_WORKER_RESTARTS = METRICS.counter(
+    "eigentrust_ingest_worker_restarts_total",
+    "Verify worker-pool rebuilds after a worker process died",
+)
 
 __all__ = [
     "Counter",
@@ -426,4 +456,9 @@ __all__ = [
     "DEVICE_MEMORY_DELTA",
     "JOURNAL_EVENTS",
     "JOURNAL_DROPPED",
+    "INGEST_QUEUE_DEPTH",
+    "INGEST_SHED",
+    "INGEST_ADMISSION_SECONDS",
+    "INGEST_VERIFY_BATCHES",
+    "INGEST_WORKER_RESTARTS",
 ]

@@ -18,12 +18,13 @@ python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.
 python -m protocol_tpu.analysis --output ANALYSIS.json
 
 # Trees held to the hard format/type gates: the convergence-kernel,
-# backend, mesh-parallel, node, analyzer, observability, crypto, and zk
-# code.  crypto/ and zk/ were promoted from informational with the
-# analyzer work; obs/ joined with the telemetry subsystem (ISSUE 4) —
-# the whole proving + serving + instrumentation path sits behind the
-# same wall as the kernels.
-HARD_TREES="protocol_tpu/ops protocol_tpu/trust protocol_tpu/parallel protocol_tpu/node protocol_tpu/analysis protocol_tpu/obs protocol_tpu/crypto protocol_tpu/zk"
+# backend, mesh-parallel, node, analyzer, observability, crypto, zk,
+# and admission-plane code.  crypto/ and zk/ were promoted from
+# informational with the analyzer work; obs/ joined with the telemetry
+# subsystem (ISSUE 4); ingest/ with the admission plane (ISSUE 7) —
+# the whole admission + proving + serving + instrumentation path sits
+# behind the same wall as the kernels.
+HARD_TREES="protocol_tpu/ops protocol_tpu/trust protocol_tpu/parallel protocol_tpu/node protocol_tpu/analysis protocol_tpu/obs protocol_tpu/crypto protocol_tpu/zk protocol_tpu/ingest"
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check .

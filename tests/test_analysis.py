@@ -450,3 +450,77 @@ class TestJournalBoundaryRule:
     def test_seeded_fixture_registered(self):
         assert "journal-write-in-jit" in FIXTURES
         assert FIXTURES["journal-write-in-jit"].kind == "ast"
+
+
+class TestEpochLoopIngestRule:
+    """Pass 6: the epoch loop neither verifies signatures nor blocks on
+    an unbounded queue put (ISSUE 7)."""
+
+    def test_sync_verify_in_epoch_loop_file(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/epoch.py",
+            "def tick(manager, att):\n"
+            "    return manager.add_attestation(att)\n",
+        )
+        assert [f.rule for f in findings] == ["blocking-ingest-in-epoch-loop"]
+        assert findings[0].file == "protocol_tpu/node/epoch.py"
+        assert findings[0].line == 2
+
+    def test_unbounded_put_in_pipeline_file(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/pipeline.py",
+            "import queue\nQ = queue.Queue()\n"
+            "def submit(prepared):\n"
+            "    Q.put(prepared)\n",
+        )
+        assert [f.rule for f in findings] == ["blocking-ingest-in-epoch-loop"]
+        assert findings[0].line == 4
+
+    def test_bounded_puts_and_put_nowait_are_fine(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/pipeline.py",
+            "import queue\nQ = queue.Queue(maxsize=1)\n"
+            "def submit(prepared):\n"
+            "    Q.put_nowait(prepared)\n"
+            "    Q.put(prepared, timeout=0.05)\n"
+            "    Q.put(prepared, block=False)\n",
+        )
+        assert findings == []
+
+    def test_same_code_outside_epoch_loop_files_is_fine(self, tmp_path):
+        """The rule is file-scoped: the admission plane itself (and any
+        other module) verifies and enqueues freely."""
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/ingest/plane.py",
+            "import queue\nQ = queue.Queue()\n"
+            "def run(manager, att):\n"
+            "    Q.put(att)\n"
+            "    return manager.add_attestations_bulk([att])\n",
+        )
+        assert findings == []
+
+    def test_eddsa_verify_call_detected(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/epoch.py",
+            "from protocol_tpu.crypto import native as cnative\n"
+            "def tick(sig):\n"
+            "    return cnative.eddsa_verify_batch([sig], [], [], [], [], [])\n",
+        )
+        assert [f.rule for f in findings] == ["blocking-ingest-in-epoch-loop"]
+
+    def test_seeded_fixture_registered(self):
+        assert "blocking-ingest-in-epoch-loop" in FIXTURES
+        assert FIXTURES["blocking-ingest-in-epoch-loop"].kind == "ast"
+
+    def test_real_epoch_loop_files_are_clean(self):
+        from protocol_tpu.analysis.ast_rules import EPOCH_LOOP_FILES
+
+        root = FIXTURES_PATH.resolve().parents[2]
+        for rel in EPOCH_LOOP_FILES:
+            findings = scan_file(root / rel, root)
+            assert findings == [], (rel, findings)

@@ -38,7 +38,7 @@ class TestClientAttest:
         cfg = make_config(tmp_path)
         client = EigenTrustClient(cfg, bootstrap_nodes())
         att = client.build_attestation()
-        Manager().add_attestation(att)  # raises on any invalidity
+        assert Manager().add_attestation(att).accepted
         assert att.scores == [300, 100, 100, 300, 200]
 
     def test_attest_writes_fixture_event(self, tmp_path):

@@ -1,0 +1,427 @@
+"""Admission-plane tests (ISSUE 7): sharded dedup, rate limits, the
+verify worker pool (including crash recovery), the IngestPlane
+pipeline, and the node's POST /attestation route with 429 shed.
+
+Adversarial acceptance coverage: replay of an already-accepted
+attestation, out-of-order nonces, rate-limit exhaustion followed by
+token refill, and worker-crash recovery (the pool respawns; an
+in-flight batch is retried or rejected with a distinct reason code —
+never silently dropped)."""
+
+import asyncio
+import threading
+import time
+from concurrent.futures.process import BrokenProcessPool
+
+import pytest
+
+from protocol_tpu.crypto import (
+    calculate_message_hash,
+    group_pks_hash,
+    message_hash_batch,
+)
+from protocol_tpu.crypto.eddsa import sign
+from protocol_tpu.ingest import IngestPlane, IngestPlaneConfig, ShardedDedupCache
+from protocol_tpu.ingest.plane import SHED_REASON
+from protocol_tpu.ingest.ratelimit import AdmissionPolicy, RateLimitConfig
+from protocol_tpu.ingest.workers import (
+    CRASH_MARKER,
+    VerifyCrashed,
+    VerifyPool,
+    verify_batch,
+)
+from protocol_tpu.node.attestation import Attestation, AttestationData
+from protocol_tpu.node.bootstrap import FIXED_SET, keyset_from_raw
+from protocol_tpu.node.manager import IngestResult, Manager, ManagerConfig
+from protocol_tpu.obs import metrics as obs_metrics
+
+SKS, PKS = keyset_from_raw(FIXED_SET)
+GROUP_HASH = group_pks_hash(PKS)
+
+
+def make_att(i: int, sender: int = 0, bad_sig: bool = False) -> Attestation:
+    """Unique validly-signed attestation #i (scores sum to SCALE)."""
+    d = i % 190
+    scores = [200 + d, 200 - d, 200, 200, 200]
+    _, msgs = calculate_message_hash(PKS, [scores])
+    sig = sign(SKS[sender], PKS[sender], msgs[0] + (1 if bad_sig else 0))
+    return Attestation(sig=sig, pk=PKS[sender], neighbours=list(PKS), scores=scores)
+
+
+def work_item(att: Attestation):
+    return (
+        att.sig.big_r.x,
+        att.sig.big_r.y,
+        att.sig.s,
+        att.pk.point.x,
+        att.pk.point.y,
+        tuple(att.scores),
+    )
+
+
+def fresh_manager() -> Manager:
+    return Manager(ManagerConfig(prover="commitment"))
+
+
+def open_plane(manager=None, **kw) -> IngestPlane:
+    defaults = dict(
+        workers=0,
+        batch_size=8,
+        rate=RateLimitConfig(rate=1e6, burst=1e6),
+    )
+    defaults.update(kw)
+    return IngestPlane(manager or fresh_manager(), IngestPlaneConfig(**defaults))
+
+
+class TestMessageHashBatch:
+    def test_parity_with_reference_path(self):
+        rows = [[200] * 5, [100, 300, 200, 150, 250], [999, 1, 0, 0, 0]]
+        ph, ref = calculate_message_hash(PKS, rows)
+        assert ph == GROUP_HASH
+        assert message_hash_batch(GROUP_HASH, rows) == ref
+
+    def test_multi_chunk_rows_match_sponge(self):
+        # Rows wider than the sponge width take two absorb rounds.
+        from protocol_tpu.crypto import PoseidonSponge, permute
+
+        rows = [[i * 7 + j for j in range(7)] for i in range(3)]
+        expected = []
+        for row in rows:
+            sponge = PoseidonSponge()
+            sponge.update(row)
+            expected.append(permute([GROUP_HASH, sponge.squeeze(), 0, 0, 0])[0])
+        assert message_hash_batch(GROUP_HASH, rows) == expected
+
+
+class TestShardedDedup:
+    def test_duplicate_rejected(self):
+        cache = ShardedDedupCache(n_shards=4)
+        sender = (1, 2)
+        assert cache.admit(sender, b"d1") is None
+        assert cache.admit(sender, b"d1") == "duplicate"
+        assert cache.admit(sender, b"d2") is None
+
+    def test_nonce_monotonic(self):
+        cache = ShardedDedupCache()
+        sender = (3, 4)
+        assert cache.admit(sender, b"a", nonce=5) is None
+        # Out-of-order and replayed nonces both die as stale.
+        assert cache.admit(sender, b"b", nonce=5) == "stale-nonce"
+        assert cache.admit(sender, b"c", nonce=4) == "stale-nonce"
+        assert cache.admit(sender, b"d", nonce=6) is None
+        # A nonce-less submission from the same sender still dedups by
+        # digest only.
+        assert cache.admit(sender, b"e") is None
+
+    def test_epoch_rotation_forgets_after_two_epochs(self):
+        cache = ShardedDedupCache()
+        sender = (5, 6)
+        assert cache.admit(sender, b"x") is None
+        cache.rotate_all()
+        assert cache.admit(sender, b"x") == "duplicate"  # previous gen
+        cache.rotate_all()
+        cache.rotate_all()
+        assert cache.admit(sender, b"x") is None  # aged out
+
+    def test_overflow_rotates_bounded(self):
+        cache = ShardedDedupCache(n_shards=1, hashes_per_shard=8)
+        sender = (7, 8)
+        for i in range(64):
+            cache.admit(sender, bytes([i]))
+        assert len(cache) <= 16  # two generations of 8
+
+
+class TestAdmissionPolicy:
+    def test_exhaustion_then_refill(self):
+        clock = [0.0]
+        policy = AdmissionPolicy(
+            RateLimitConfig(rate=10.0, burst=3.0), clock=lambda: clock[0]
+        )
+        sender = (1, 1)
+        assert [policy.check(sender) for _ in range(3)] == [None] * 3
+        assert policy.check(sender) == "rate-limited"
+        # Refill: 0.2s at 10/s = 2 tokens.
+        clock[0] += 0.2
+        assert policy.check(sender) is None
+        assert policy.check(sender) is None
+        assert policy.check(sender) == "rate-limited"
+
+    def test_whitelist_bypass(self):
+        sender = (2, 2)
+        policy = AdmissionPolicy(
+            RateLimitConfig(rate=1.0, burst=1.0, whitelist=frozenset({sender}))
+        )
+        assert all(policy.check(sender) is None for _ in range(50))
+
+    def test_spam_score_from_rejection_history(self):
+        clock = [0.0]
+        policy = AdmissionPolicy(
+            RateLimitConfig(rate=1e6, burst=1e6, spam_threshold=2.0),
+            clock=lambda: clock[0],
+        )
+        sender = (3, 3)
+        assert policy.check(sender) is None
+        for _ in range(20):  # downstream verdicts: all garbage
+            policy.record_outcome(sender, False)
+        assert policy.score(sender) > 2.0
+        assert policy.check(sender) == "spam-score"
+
+
+class TestVerifyPool:
+    def test_inline_verdicts(self):
+        good, bad = make_att(1), make_att(2, bad_sig=True)
+        assert verify_batch(GROUP_HASH, [work_item(good), work_item(bad)]) == [
+            True,
+            False,
+        ]
+
+    def test_pooled_verdicts_and_crash_recovery(self):
+        good, bad = make_att(3), make_att(4, bad_sig=True)
+        pool = VerifyPool(workers=1)
+        try:
+            assert pool.verify(GROUP_HASH, [work_item(good), work_item(bad)]) == [
+                True,
+                False,
+            ]
+            restarts0 = obs_metrics.INGEST_WORKER_RESTARTS.value()
+            # A batch whose worker dies on every attempt must come back
+            # as VerifyCrashed (the caller rejects it with a reason
+            # code), never hang or vanish.
+            with pytest.raises(VerifyCrashed):
+                pool.verify(GROUP_HASH, [work_item(good), CRASH_MARKER])
+            assert obs_metrics.INGEST_WORKER_RESTARTS.value() > restarts0
+            # The pool respawned: the next batch verifies normally.
+            assert pool.verify(GROUP_HASH, [work_item(good)]) == [True]
+        finally:
+            pool.close()
+
+    def test_crash_retry_succeeds_on_respawned_pool(self):
+        """First attempt dies (broken executor), the retry lands on the
+        rebuilt pool — the in-flight batch is retried, not dropped."""
+
+        class FlakyExecutor:
+            def submit(self, fn, *args):
+                raise BrokenProcessPool("worker died")
+
+            def shutdown(self, wait=True, cancel_futures=False):
+                pass
+
+        pool = VerifyPool(workers=0)  # inline fallback after restart
+        pool._executor = FlakyExecutor()
+        retried0 = obs_metrics.INGEST_VERIFY_BATCHES.value(outcome="retried")
+        try:
+            good = make_att(5)
+            # Attempt 1 hits the flaky executor; _restart drops it back
+            # to inline (workers=0 -> _make unused because executor is
+            # cleared only via generation bump) — emulate by patching
+            # _make to return None-equivalent inline path.
+            pool._make = lambda: None
+            assert pool.verify(GROUP_HASH, [work_item(good)]) == [True]
+            assert (
+                obs_metrics.INGEST_VERIFY_BATCHES.value(outcome="retried")
+                > retried0
+            )
+        finally:
+            pool.close()
+
+
+class TestIngestPlane:
+    def test_accept_replay_badsig_and_nonces(self):
+        manager = fresh_manager()
+        with open_plane(manager) as plane:
+            futs = [plane.submit(make_att(i, sender=i % 5)) for i in range(6)]
+            replay = plane.submit(make_att(2, sender=2))
+            bad = plane.submit(make_att(40, bad_sig=True))
+            n5 = plane.submit(make_att(50, sender=1), nonce=5)
+            stale = plane.submit(make_att(51, sender=1), nonce=4)
+            assert plane.drain(30)
+            assert all(f.result().accepted for f in futs)
+            assert replay.result().reason == "duplicate"
+            assert bad.result().reason == "bad-signature"
+            assert n5.result().accepted
+            assert stale.result().reason == "stale-nonce"
+            # Accepted attestations landed in the manager's cache.
+            assert len(manager.attestations) == 5
+            stats = plane.stats()
+            assert stats["accepted"] == 7 and stats["pending"] == 0
+
+    def test_structural_rejects_never_reach_verify(self):
+        manager = fresh_manager()
+        with open_plane(manager) as plane:
+            calls = []
+            original = plane.pool.verify
+            plane.pool.verify = lambda *a: (calls.append(1), original(*a))[1]
+            att = make_att(1)
+            outsider = Attestation(
+                sig=att.sig,
+                pk=att.pk,
+                neighbours=list(reversed(att.neighbours)),
+                scores=att.scores,
+            )
+            fut = plane.submit(outsider)
+            assert plane.drain(30)
+            assert fut.result().reason == "group-mismatch"
+            assert not calls  # rejected before any signature work
+
+    def test_rate_exhaustion_then_refill_through_plane(self):
+        clock = [0.0]
+        manager = fresh_manager()
+        with open_plane(
+            manager, rate=RateLimitConfig(rate=10.0, burst=2.0)
+        ) as plane:
+            plane.policy = AdmissionPolicy(
+                RateLimitConfig(rate=10.0, burst=2.0), clock=lambda: clock[0]
+            )
+            futs = [plane.submit(make_att(i)) for i in range(4)]
+            assert plane.drain(30)
+            verdicts = [f.result() for f in futs]
+            assert sum(v.accepted for v in verdicts) == 2
+            assert {v.reason for v in verdicts if not v.accepted} == {
+                "rate-limited"
+            }
+            clock[0] += 1.0  # refill 10 tokens (capped at burst=2)
+            futs = [plane.submit(make_att(100 + i)) for i in range(2)]
+            assert plane.drain(30)
+            assert all(f.result().accepted for f in futs)
+
+    def test_full_queue_sheds_with_reason(self):
+        manager = fresh_manager()
+        hold = threading.Event()
+        with open_plane(
+            manager, submit_queue_max=1, batch_queue_max=1, batch_size=1
+        ) as plane:
+            plane.pool.verify = lambda *a: (hold.wait(10), [True])[1]
+            futs = [plane.submit(make_att(i)) for i in range(12)]
+            time.sleep(0.2)  # let the pipeline wedge against the hold
+            shed = [
+                f for f in futs if f.done() and f.result().reason == SHED_REASON
+            ]
+            assert shed, "bounded intake never shed under a wedged verifier"
+            assert plane.shed == len(shed)
+            assert (
+                obs_metrics.INGEST_SHED.value(stage="submit") >= len(shed)
+            )
+            hold.set()
+            assert plane.drain(30)
+
+    def test_worker_crash_rejects_with_reason_never_drops(self):
+        manager = fresh_manager()
+        with open_plane(manager) as plane:
+            def crashed(*a):
+                raise VerifyCrashed("worker died twice")
+
+            plane.pool.verify = crashed
+            futs = [plane.submit(make_att(i)) for i in range(3)]
+            assert plane.drain(30)
+            assert [f.result().reason for f in futs] == ["verify-crashed"] * 3
+            assert plane.stats()["pending"] == 0
+
+    def test_epoch_rotation_reopens_dedup(self):
+        manager = fresh_manager()
+        with open_plane(manager) as plane:
+            att = make_att(7)
+            assert plane.submit(att).result(10).accepted
+            assert plane.submit(att).result(10).reason == "duplicate"
+            plane.advance_epoch()
+            plane.advance_epoch()
+            assert plane.submit(att).result(10).accepted
+
+    def test_close_resolves_pending_futures(self):
+        manager = fresh_manager()
+        hold = threading.Event()
+        plane = open_plane(manager, batch_size=1)
+        plane.start()
+        plane.pool.verify = lambda *a: (hold.wait(10), [True])[1]
+        futs = [plane.submit(make_att(i)) for i in range(4)]
+        hold.set()
+        plane.close(drain=False)
+        for f in futs:
+            assert f.result(timeout=10) is not None  # never left hanging
+
+
+class TestManagerUniformIngestResult:
+    def test_single_item_matches_bulk_shape(self):
+        m = fresh_manager()
+        ok = m.add_attestation(make_att(1))
+        assert isinstance(ok, IngestResult) and ok.accepted
+        bad = m.add_attestation(make_att(2, bad_sig=True))
+        assert (bad.accepted, bad.reason) == (False, "bad-signature")
+        att = make_att(3)
+        att.neighbours = list(reversed(att.neighbours))
+        assert m.add_attestation(att).reason == "group-mismatch"
+        # Identical verdict objects from the bulk path.
+        assert m.add_attestations_bulk([make_att(4)])[0].accepted
+
+    def test_apply_verified_skips_checks(self):
+        m = fresh_manager()
+        att = make_att(5)
+        assert m.apply_verified(att).accepted
+        assert m.attestations[att.pk.hash()] is att
+
+
+class TestServerIngestRoute:
+    @staticmethod
+    async def _post(port, body, path="/attestation"):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            f"POST {path} HTTP/1.1\r\nhost: t\r\n"
+            f"content-length: {len(body)}\r\n\r\n".encode() + body
+        )
+        await writer.drain()
+        response = (await reader.read()).decode()
+        writer.close()
+        head, _, payload = response.partition("\r\n\r\n")
+        return int(head.split()[1]), payload
+
+    def test_post_accept_replay_and_shed(self):
+        from protocol_tpu.node.config import ProtocolConfig
+        from protocol_tpu.node.server import Node
+
+        async def scenario():
+            cfg = ProtocolConfig(
+                epoch_interval=3600,
+                endpoint=((127, 0, 0, 1), 0),
+                prover="commitment",
+            )
+            node = Node.from_config(cfg)
+            await node.start()
+            port = node._server.sockets[0].getsockname()[1]
+            payload = AttestationData.from_attestation(make_att(11)).to_bytes()
+            first = await self._post(port, payload)
+            replay = await self._post(port, payload)
+            garbage = await self._post(port, b"\x00" * 31)
+            # Wedge the verifier and flood a 1-slot queue: the bounded
+            # intake must answer 429, not queue without bound.  The
+            # flood runs concurrently (queued verdicts only resolve
+            # once the verifier is released).
+            hold = threading.Event()
+            node._ingest.pool.verify = lambda ph, items: (
+                hold.wait(10),
+                [True] * len(items),
+            )[1]
+            node._ingest._submit_queue.maxsize = 1
+            node._ingest._batch_queue.maxsize = 1
+            flood_task = asyncio.gather(
+                *[
+                    self._post(
+                        port,
+                        AttestationData.from_attestation(
+                            make_att(20 + i, sender=i % 5)
+                        ).to_bytes(),
+                    )
+                    for i in range(8)
+                ]
+            )
+            await asyncio.sleep(0.5)
+            hold.set()
+            floods = await flood_task
+            await node.stop()
+            return first, replay, garbage, floods
+
+        first, replay, garbage, floods = asyncio.run(scenario())
+        assert first[0] == 200 and '"accepted": true' in first[1]
+        assert replay[0] == 400 and "duplicate" in replay[1]
+        assert garbage[0] == 400 and "malformed-payload" in garbage[1]
+        assert any(status == 429 for status, _ in floods), floods
+        for status, body in floods:
+            assert status in (200, 400, 429, 500), (status, body)

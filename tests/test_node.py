@@ -116,12 +116,14 @@ class TestManager:
         assert len(m.attestations) == 1
 
     def test_reject_wrong_group(self):
+        """Rejections come back as IngestResult reason codes — the same
+        shape as the bulk path (ISSUE 7 satellite), not exceptions."""
         m = Manager()
         att = make_attestation()
         att.neighbours = list(reversed(att.neighbours))
-        with pytest.raises(EigenError) as exc:
-            m.add_attestation(att)
-        assert exc.value.code == EigenErrorCode.INVALID_ATTESTATION
+        result = m.add_attestation(att)
+        assert (result.accepted, result.reason) == (False, "group-mismatch")
+        assert len(m.attestations) == 0
 
     def test_reject_outsider_sender(self):
         m = Manager()
@@ -130,23 +132,30 @@ class TestManager:
         _, msgs = calculate_message_hash(att.neighbours, [att.scores])
         att.sig = sign(outsider, outsider.public(), msgs[0])
         att.pk = outsider.public()
-        with pytest.raises(EigenError):
-            m.add_attestation(att)
+        assert m.add_attestation(att).reason == "sender-not-in-group"
 
     def test_reject_non_conserving_scores(self):
         """A validly-signed row not summing to SCALE would poison every
         epoch proof (conservation gate); rejected at ingest."""
         m = Manager()
         att = make_attestation(scores=[999, 0, 0, 0, 0])
-        with pytest.raises(EigenError, match="sum"):
-            m.add_attestation(att)
+        assert m.add_attestation(att).reason == "non-conserving-scores"
 
     def test_reject_bad_signature(self):
         m = Manager()
         att = make_attestation()
         att.sig = Signature(att.sig.big_r, field.add(att.sig.s, 1))
-        with pytest.raises(EigenError):
-            m.add_attestation(att)
+        assert m.add_attestation(att).reason == "bad-signature"
+
+    def test_single_and_bulk_verdicts_identical(self):
+        m = Manager()
+        good, bad = make_attestation(), make_attestation()
+        bad.sig = Signature(bad.sig.big_r, field.add(bad.sig.s, 1))
+        single = [m.add_attestation(good), Manager().add_attestation(bad)]
+        bulk = Manager().add_attestations_bulk([good, bad])
+        assert [(r.accepted, r.reason) for r in single] == [
+            (r.accepted, r.reason) for r in bulk
+        ]
 
     def test_get_attestation(self):
         m = Manager()

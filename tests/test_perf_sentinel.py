@@ -40,6 +40,45 @@ class TestRealSeries:
         assert headline and report["series"][headline[0]]["rounds"] == 5
 
 
+class TestIngestSeries:
+    def test_ingest_storm_rounds_feed_the_gate(self, tmp_path):
+        """ISSUE 7: INGEST_r*.json is in the default globs, its
+        ``entries`` list is walked, and sigs_per_s gates downward /
+        p99_admission_ms upward."""
+        for i, (sigs, p99) in enumerate([(1000.0, 50.0), (400.0, 200.0)], start=1):
+            (tmp_path / f"INGEST_r{i:02d}.json").write_text(
+                json.dumps(
+                    {
+                        "n": i,
+                        "bench": "ingest_storm",
+                        "entries": [
+                            {
+                                "metric": "ingest-storm accepted sigs/s (honest)",
+                                "sigs_per_s": sigs,
+                                "p99_admission_ms": p99,
+                            }
+                        ],
+                    }
+                )
+            )
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1  # r02 regressed both directions vs r01
+        report = json.loads(out.read_text())
+        assert set(report["regressions"]) == {
+            "ingest-storm accepted sigs/s (honest) :: sigs_per_s",
+            "ingest-storm accepted sigs/s (honest) :: p99_admission_ms",
+        }
+
+    def test_committed_ingest_round_passes(self, tmp_path):
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(REPO), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert any("INGEST_r01.json" in f for f in report["history_files"])
+        assert any("sigs_per_s" in k for k in report["series"])
+
+
 class TestSyntheticRegression:
     def test_regressed_latest_round_fails(self, tmp_path):
         """Acceptance: exit non-zero on a synthetically regressed

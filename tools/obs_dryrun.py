@@ -98,6 +98,27 @@ async def _dryrun(out_dir: Path, epoch_interval: int, timeout_s: float) -> int:
     # One observation per iteration per epoch.
     assert residual_count >= iterations >= 1, (residual_count, iterations)
 
+    # Admission-plane backpressure surface (ISSUE 7): the queue-depth
+    # gauges and shed counters must be scrapeable from boot — HELP/TYPE
+    # advertised and the per-stage sample rows materialized at zero —
+    # so a dashboard can alert on shed>0 without waiting for traffic.
+    for name, kind in (
+        ("eigentrust_ingest_queue_depth", "gauge"),
+        ("eigentrust_ingest_shed_total", "counter"),
+        ("eigentrust_ingest_verify_batches_total", "counter"),
+        ("eigentrust_ingest_worker_restarts_total", "counter"),
+    ):
+        assert f"# TYPE {name} {kind}" in metrics_body, name
+        assert f"# HELP {name} " in metrics_body, name
+    for stage in ("submit", "verify"):
+        key = f'eigentrust_ingest_queue_depth{{stage="{stage}"}}'
+        assert key in samples, key
+    assert 'eigentrust_ingest_shed_total{stage="submit"}' in samples, samples.keys()
+    assert samples['eigentrust_ingest_shed_total{stage="submit"}'] == 0, (
+        "dryrun shed ingest traffic with no load"
+    )
+    assert "eigentrust_ingest_admission_seconds_count" in samples
+
     tree = json.loads(trace_body)
     assert tree["name"] == "epoch_tick", tree["name"]
     child_names = [c["name"] for c in tree["children"]]

@@ -1,14 +1,15 @@
 """Perf-regression sentinel over the committed bench trajectory.
 
 The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
-``LADDER_r*.json``) but nothing ever *read* the series — a PR could
+``LADDER_r*.json``, and since ISSUE 7 the ingest-storm rounds
+``INGEST_r*.json``) but nothing ever *read* the series — a PR could
 halve headline throughput and no gate would notice.  This tool closes
 the loop: it parses the recorded rounds into per-metric series
 (headline convergence seconds, cold/steady-state epoch seconds, plan
-build seconds, sigs/s, power-iters/s), optionally folds in a fresh
-bench entry, and exits non-zero when the newest value regresses more
-than ``--threshold`` against the best value the repo has ever
-recorded.
+build seconds, sigs/s, power-iters/s, p99 admission latency), optionally
+folds in a fresh bench entry, and exits non-zero when the newest value
+regresses more than ``--threshold`` against the best value the repo has
+ever recorded.
 
 Series are keyed by the exact ``metric`` string plus the field name,
 so differently-shaped runs (CI smoke vs the recorded 1M-peer rounds)
@@ -46,6 +47,7 @@ _FIELDS = {
     "steady_state_epoch_seconds": True,
     "sigs_per_s": False,
     "power_iters_per_sec": False,
+    "p99_admission_ms": True,
 }
 
 
@@ -65,7 +67,8 @@ def _lower_is_better(field: str, entry: dict[str, Any]) -> bool | None:
 def _entries(obj: Any) -> Iterator[dict[str, Any]]:
     """Every bench entry inside one parsed JSON document: driver
     records ({"parsed": {...}}), ladder reports ({"ladder": [...]}),
-    bare entries, or lists of any of those."""
+    ingest-storm reports ({"entries": [...]}), bare entries, or lists
+    of any of those."""
     if isinstance(obj, list):
         for item in obj:
             yield from _entries(item)
@@ -77,6 +80,9 @@ def _entries(obj: Any) -> Iterator[dict[str, Any]]:
         return
     if "ladder" in obj and isinstance(obj["ladder"], list):
         yield from _entries(obj["ladder"])
+        return
+    if "entries" in obj and isinstance(obj["entries"], list):
+        yield from _entries(obj["entries"])
         return
     if "metric" in obj:
         yield obj
@@ -201,8 +207,8 @@ def main(argv: list[str] | None = None) -> int:
         "--glob",
         action="append",
         default=None,
-        help="history filename glob(s); default: BENCH_r*.json and "
-        "LADDER_r*.json",
+        help="history filename glob(s); default: BENCH_r*.json, "
+        "LADDER_r*.json, and INGEST_r*.json",
     )
     ap.add_argument(
         "--fresh",
@@ -221,7 +227,7 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     root = Path(args.history) if args.history else Path(__file__).resolve().parent.parent
-    patterns = args.glob or ["BENCH_r*.json", "LADDER_r*.json"]
+    patterns = args.glob or ["BENCH_r*.json", "LADDER_r*.json", "INGEST_r*.json"]
     paths = [
         Path(p) for pat in patterns for p in globlib.glob(str(root / pat))
     ]
