@@ -41,7 +41,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--pass",
         dest="passes",
-        choices=("all", "jaxpr", "ast"),
+        choices=("all", "jaxpr", "ast", "concurrency"),
         default="all",
         help="which pass(es) to run (default: %(default)s)",
     )
@@ -92,6 +92,12 @@ def main(argv: list[str] | None = None) -> int:
             findings, n_files = run_ast_pass()
             report.extend(findings)
             report.files_scanned = n_files
+        if args.passes in ("all", "concurrency"):
+            from .concurrency import run_concurrency_pass
+
+            findings, section = run_concurrency_pass()
+            report.extend(findings)
+            report.concurrency = section
 
     report.write_json(args.output)
     print(report.render())

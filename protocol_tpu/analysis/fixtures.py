@@ -273,6 +273,151 @@ def _blocking_ingest_in_epoch_loop() -> tuple[str, str]:
     return _BLOCKING_INGEST_SRC, "protocol_tpu/node/pipeline.py"
 
 
+#: Pass-7 seeded violations (whole-program concurrency rules).  Each
+#: source is a self-contained "program": it declares its own thread
+#: roots, so the analyzer's reachability machinery runs exactly as it
+#: does on the real tree.  Paths land outside the thread-confined
+#: trees so the shared-state rules apply.
+_UNGUARDED_SHARED_ATTR_SRC = '''\
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def read(self):
+        return self.count  # VIOLATION: unguarded-shared-attr
+
+
+def run():
+    t = Tally()
+    threading.Thread(target=t.bump).start()
+    threading.Thread(target=t.read).start()
+'''
+
+
+def _unguarded_shared_attr() -> tuple[str, str]:
+    return _UNGUARDED_SHARED_ATTR_SRC, "protocol_tpu/node/_fixture_shared_attr.py"
+
+
+_UNGUARDED_RMW_SRC = '''\
+import threading
+
+
+class Hits:
+    def __init__(self):
+        self.n = 0
+
+    def work(self):
+        self.n += 1  # VIOLATION: unguarded-rmw
+
+
+def run():
+    h = Hits()
+    threading.Thread(target=h.work, name="w1").start()
+    threading.Thread(target=h.work, name="w2").start()
+'''
+
+
+def _unguarded_rmw() -> tuple[str, str]:
+    return _UNGUARDED_RMW_SRC, "protocol_tpu/obs/_fixture_rmw.py"
+
+
+_CHECK_THEN_ACT_SRC = '''\
+import threading
+
+
+class Once:
+    def __init__(self):
+        self.started = False
+
+    def boot(self):
+        if not self.started:
+            self.started = True  # VIOLATION: check-then-act
+
+
+def run():
+    o = Once()
+    threading.Thread(target=o.boot, name="a").start()
+    threading.Thread(target=o.boot, name="b").start()
+'''
+
+
+def _check_then_act() -> tuple[str, str]:
+    return _CHECK_THEN_ACT_SRC, "protocol_tpu/ingest/_fixture_check_act.py"
+
+
+_LOCK_ORDER_CYCLE_SRC = '''\
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:  # VIOLATION: lock-order-cycle
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+
+def _lock_order_cycle() -> tuple[str, str]:
+    return _LOCK_ORDER_CYCLE_SRC, "protocol_tpu/node/_fixture_lock_order.py"
+
+
+_BLOCKING_UNDER_LOCK_SRC = '''\
+import queue
+import threading
+
+
+class Stage:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = queue.Queue(maxsize=4)
+
+    def push(self, item):
+        with self._lock:
+            self._queue.put(item)  # VIOLATION: blocking-call-under-lock
+'''
+
+
+def _blocking_call_under_lock() -> tuple[str, str]:
+    return _BLOCKING_UNDER_LOCK_SRC, "protocol_tpu/ingest/_fixture_block_lock.py"
+
+
+_NATIVE_UNDER_LOCK_SRC = '''\
+import threading
+
+from protocol_tpu.crypto import native as cnative
+
+
+class Verifier:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def check(self, sigs):
+        with self._lock:
+            return cnative.eddsa_verify_batch(sigs)  # VIOLATION: native-call-under-lock
+'''
+
+
+def _native_call_under_lock() -> tuple[str, str]:
+    return _NATIVE_UNDER_LOCK_SRC, "protocol_tpu/node/_fixture_native_lock.py"
+
+
 FIXTURES: dict[str, Fixture] = {
     f.name: f
     for f in (
@@ -320,6 +465,33 @@ FIXTURES: dict[str, Fixture] = {
             _blocking_ingest_in_epoch_loop, "blocking-ingest-in-epoch-loop",
             kind="ast",
         ),
+        Fixture(
+            "unguarded-shared-attr", "unguarded-shared-attr",
+            _unguarded_shared_attr, "unguarded-shared-attr",
+            kind="concurrency",
+        ),
+        Fixture(
+            "unguarded-rmw", "unguarded-rmw", _unguarded_rmw,
+            "unguarded-rmw", kind="concurrency",
+        ),
+        Fixture(
+            "check-then-act", "check-then-act", _check_then_act,
+            "check-then-act", kind="concurrency",
+        ),
+        Fixture(
+            "lock-order-cycle", "lock-order-cycle", _lock_order_cycle,
+            "lock-order-cycle", kind="concurrency",
+        ),
+        Fixture(
+            "blocking-call-under-lock", "blocking-call-under-lock",
+            _blocking_call_under_lock, "blocking-call-under-lock",
+            kind="concurrency",
+        ),
+        Fixture(
+            "native-call-under-lock", "native-call-under-lock",
+            _native_call_under_lock, "native-call-under-lock",
+            kind="concurrency",
+        ),
     )
 }
 
@@ -333,6 +505,11 @@ def run_fixture(name: str) -> list[Finding]:
 
         source, rel_path = fixture.build()
         return scan_source(source, rel_path)
+    if fixture.kind == "concurrency":
+        from .concurrency import analyze_sources
+
+        source, rel_path = fixture.build()
+        return analyze_sources({rel_path: source})
     budget, case = fixture.build()
     return check_case(budget, case)
 

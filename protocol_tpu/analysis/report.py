@@ -64,6 +64,10 @@ class Report:
     backends: dict[str, dict[str, Any]] = field(default_factory=dict)
     #: Files scanned by pass 2.
     files_scanned: int = 0
+    #: Pass-7 whole-program concurrency section: execution roots, the
+    #: inferred guard map, the lock-order graph, and the enumerated
+    #: waiver list (analysis/concurrency/).
+    concurrency: dict[str, Any] = field(default_factory=dict)
 
     def extend(self, findings: list[Finding]) -> None:
         self.findings.extend(findings)
@@ -89,6 +93,7 @@ class Report:
                 "files_scanned": self.files_scanned,
             },
             "backends": self.backends,
+            "concurrency": self.concurrency,
             "findings": [f.to_dict() for f in self.findings],
         }
 

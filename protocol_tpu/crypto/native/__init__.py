@@ -9,12 +9,21 @@ pure-Python fallback, and parity tests assert bit-identical results.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 from pathlib import Path
 
 import numpy as np
 
-_NATIVE_DIR = Path(__file__).resolve().parents[3] / "native"
+#: PROTOCOL_TPU_NATIVE_DIR points the loaders at an alternate build —
+#: the sanitizer wall (tools/sanitize_native.py) runs the test suite
+#: against ASAN/UBSAN/TSAN-instrumented variants without clobbering
+#: the optimized libraries.
+_NATIVE_DIR = (
+    Path(os.environ["PROTOCOL_TPU_NATIVE_DIR"]).resolve()
+    if os.environ.get("PROTOCOL_TPU_NATIVE_DIR")
+    else Path(__file__).resolve().parents[3] / "native"
+)
 _LIB_PATH = _NATIVE_DIR / "libprotocol_native.so"
 #: None = untried, False = load/build failed (negative cache so a
 #: compiler-less host doesn't re-spawn make per call), else the CDLL.

@@ -131,23 +131,30 @@ class IngestPlane:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "IngestPlane":
-        if not self._started:
+        # Flip under the condition lock: the node's boot path and a
+        # bench driver can race start(), and a bare check-then-act
+        # would double-start the stage threads.
+        with self._cv:
+            if self._started:
+                return self
             self._started = True
-            # Materialize the backpressure surface in /metrics from
-            # boot: gauges at zero, labeled counters at zero rows.
-            obs_metrics.INGEST_QUEUE_DEPTH.set(0, stage="submit")
-            obs_metrics.INGEST_QUEUE_DEPTH.set(0, stage="verify")
-            obs_metrics.INGEST_SHED.inc(0, stage="submit")
-            obs_metrics.INGEST_VERIFY_BATCHES.inc(0, outcome="ok")
-            for t in self._threads:
-                t.start()
+        # Materialize the backpressure surface in /metrics from
+        # boot: gauges at zero, labeled counters at zero rows.
+        obs_metrics.INGEST_QUEUE_DEPTH.set(0, stage="submit")
+        obs_metrics.INGEST_QUEUE_DEPTH.set(0, stage="verify")
+        obs_metrics.INGEST_SHED.inc(0, stage="submit")
+        obs_metrics.INGEST_VERIFY_BATCHES.inc(0, outcome="ok")
+        for t in self._threads:
+            t.start()
         return self
 
     def close(self, *, drain: bool = True, timeout: float = 30.0) -> None:
-        if drain and self._started:
+        with self._cv:
+            started = self._started
+        if drain and started:
             self.drain(timeout=timeout)
         self._stop.set()
-        if self._started:
+        if started:
             for t in self._threads:
                 t.join(timeout=5.0)
         self.pool.close()
@@ -209,7 +216,8 @@ class IngestPlane:
             self._submit_queue.put_nowait(env)
             obs_metrics.INGEST_QUEUE_DEPTH.set(self._submit_queue.qsize(), stage="submit")
         except queue.Full:
-            self.shed += 1
+            with self._cv:
+                self.shed += 1
             obs_metrics.INGEST_SHED.inc(stage="submit")
             JOURNAL.record("ingest-shed", stage="submit")
             self._resolve(env, False, SHED_REASON)
@@ -319,12 +327,10 @@ class IngestPlane:
         from ..node.manager import IngestResult
 
         obs_metrics.INGEST_ADMISSION_SECONDS.observe(time.perf_counter() - env.enqueued)
+        why = None if accepted else (reason or "unknown")
         if accepted:
-            self.accepted += 1
             self.policy.record_outcome(env.sender, True)
         else:
-            why = reason or "unknown"
-            self.rejections[why] = self.rejections.get(why, 0) + 1
             obs_metrics.ATTESTATIONS_REJECTED.inc(reason=why)
             JOURNAL.record("ingest-reject", reason=why)
             # The policy already tallied its own verdicts; sheds are
@@ -332,20 +338,26 @@ class IngestPlane:
             if why not in ("rate-limited", "spam-score", SHED_REASON, "shutdown"):
                 self.policy.record_outcome(env.sender, False)
         env.future.set_result(IngestResult(accepted, reason))
+        # Verdict tallies are resolved from three roots (intake shed,
+        # the admission thread, every dispatcher) — the condition lock
+        # that already serializes _pending covers them too.
         with self._cv:
+            if accepted:
+                self.accepted += 1
+            else:
+                self.rejections[why] = self.rejections.get(why, 0) + 1
             self._pending -= 1
             self._cv.notify_all()
 
     def stats(self) -> dict:
         """Per-instance verdict snapshot (the bench's report source)."""
         with self._cv:
-            pending = self._pending
-        return {
-            "accepted": self.accepted,
-            "shed": self.shed,
-            "rejections": dict(self.rejections),
-            "pending": pending,
-        }
+            return {
+                "accepted": self.accepted,
+                "shed": self.shed,
+                "rejections": dict(self.rejections),
+                "pending": self._pending,
+            }
 
 
 __all__ = ["IngestPlane", "IngestPlaneConfig", "SHED_REASON"]

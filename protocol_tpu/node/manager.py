@@ -15,6 +15,7 @@ Rebuild of server/src/manager/mod.rs:72-237.  Differences by design:
 from __future__ import annotations
 
 import logging
+import threading
 from contextlib import contextmanager
 from dataclasses import dataclass, field as dc_field
 
@@ -152,6 +153,16 @@ class Manager:
         #: boot, so warm start survives restart).
         self.last_scores: np.ndarray | None = None
         self.last_peer_hashes: list[int] | None = None
+        #: Guards the cross-epoch mutable state shared between the
+        #: pipeline's host stage (prepare_epoch on the submit thread),
+        #: the device stage (converge_prepared on the worker thread),
+        #: and the ingest threads (apply_verified / bulk ingest): the
+        #: dirty-sender set, the warm-start snapshot — scores and their
+        #: peer-hash column must be read as a matched pair, or a warm
+        #: seed built mid-publish maps scores onto the wrong peers —
+        #: and the window-plan cache handoff.  Pinned by graftlint
+        #: pass 7 (analysis/concurrency/).
+        self._state_lock = threading.Lock()
         #: Senders whose attestation changed since the window plan last
         #: advanced — the delta-plan churn source.  Accumulates across
         #: failed epochs; cleared per successful converge.
@@ -243,7 +254,8 @@ class Manager:
         GIL-atomic dict writes."""
         h = self._pk_hash(att.pk)
         self.attestations[h] = att
-        self._dirty_hashes.add(h)
+        with self._state_lock:
+            self._dirty_hashes.add(h)
         obs_metrics.ATTESTATIONS_ACCEPTED.inc()
         return IngestResult(True)
 
@@ -301,7 +313,8 @@ class Manager:
                 if ok:
                     h = self._pk_hash(att.pk)
                     self.attestations[h] = att
-                    self._dirty_hashes.add(h)
+                    with self._state_lock:
+                        self._dirty_hashes.add(h)
                     results[i] = IngestResult(True)
                     obs_metrics.ATTESTATIONS_ACCEPTED.inc()
                 else:
@@ -330,7 +343,8 @@ class Manager:
             att = Attestation(sig=sig, pk=pk, neighbours=list(pks), scores=list(row))
             h = pk.hash()
             self.attestations[h] = att
-            self._dirty_hashes.add(h)
+            with self._state_lock:
+                self._dirty_hashes.add(h)
 
     # -- per-epoch computation ------------------------------------------
 
@@ -388,10 +402,14 @@ class Manager:
         L1-renormalized.  None (cold start) when there is no previous
         state or the overlap is empty — the backends treat None as
         "start from the pre-trust vector"."""
-        if self.last_scores is None or self.last_peer_hashes is None:
+        # Scores and their peer-hash column publish together in
+        # converge_prepared (pipeline device thread); read them as a
+        # matched pair or the warm seed maps scores onto wrong peers.
+        with self._state_lock:
+            scores, hashes = self.last_scores, self.last_peer_hashes
+        if scores is None or hashes is None:
             return None
-        prev = {h: i for i, h in enumerate(self.last_peer_hashes)}
-        scores = self.last_scores
+        prev = {h: i for i, h in enumerate(hashes)}
         t0 = np.zeros(len(id_order), np.float64)
         hits = 0
         for i, h in enumerate(id_order):
@@ -414,7 +432,8 @@ class Manager:
         covers both windowed rungs and future sharded composites
         without name dispatch."""
         if hasattr(backend, "plan"):
-            backend.plan = self.window_plan
+            with self._state_lock:
+                backend.plan = self.window_plan
         if hasattr(backend, "delta_rows"):
             backend.delta_rows = delta_rows
         try:
@@ -422,7 +441,8 @@ class Manager:
         finally:
             plan = getattr(backend, "last_plan", None)
             if plan is not None:
-                self.window_plan = plan
+                with self._state_lock:
+                    self.window_plan = plan
 
     def prepare_epoch(self, epoch: Epoch) -> PreparedEpoch:
         """Host stage of one epoch: snapshot the dirty-sender set,
@@ -431,7 +451,11 @@ class Manager:
         pipeline overlaps this with the previous epoch's device work."""
         # Snapshot BEFORE assembly: an ingest racing build_graph stays
         # dirty for the next epoch (supersets are safe, misses are not).
-        dirty = set(self._dirty_hashes)
+        # The cached plan is snapshotted in the same critical section so
+        # the churn hint below is derived against one coherent plan.
+        with self._state_lock:
+            dirty = set(self._dirty_hashes)
+            cached_plan = self.window_plan
         with TRACER.span("build_graph"):
             graph = self.build_graph()
         # A concurrent build_graph (pipelined checkpoint path) may have
@@ -442,7 +466,7 @@ class Manager:
         obs_metrics.GRAPH_EDGES.set(graph.nnz)
         t0 = self._warm_t0(id_order) if self.config.warm_start else None
         delta_rows = None
-        if self.window_plan is not None and dirty:
+        if cached_plan is not None and dirty:
             pos = {h: i for i, h in enumerate(id_order)}
             rows = np.array(
                 sorted(pos[h] for h in dirty if h in pos), dtype=np.int64
@@ -510,10 +534,15 @@ class Manager:
             obs_metrics.WARM_START_APPLIED.inc()
         # The epoch landed: its churn is folded into the cached plan
         # (or the plan was rebuilt), so those senders are clean now.
-        self._dirty_hashes -= prepared.dirty_snapshot
-        self.last_graph = graph
-        self.last_scores = result.scores
-        self.last_peer_hashes = prepared.id_order
+        # One critical section publishes the epoch's outcome: the
+        # dirty-set subtraction is a read-modify-write racing ingest
+        # .add()s, and scores/peer-hashes must land as a matched pair
+        # for the next _warm_t0.
+        with self._state_lock:
+            self._dirty_hashes -= prepared.dirty_snapshot
+            self.last_graph = graph
+            self.last_scores = result.scores
+            self.last_peer_hashes = prepared.id_order
         self.cached_results[prepared.epoch] = result
         # Convergence health → the /metrics surface: the iteration
         # count, the final residual, and the full device-captured
@@ -549,6 +578,27 @@ class Manager:
         return self.converge_prepared(
             self.prepare_epoch(epoch), alpha=alpha, tol=tol, max_iter=max_iter
         )
+
+    def restore_warm_state(
+        self,
+        *,
+        graph: TrustGraph | None = None,
+        plan: WindowPlan | None = None,
+        scores: np.ndarray | None = None,
+        peer_hashes: list[int] | None = None,
+    ) -> None:
+        """Seed the cross-epoch state from a checkpoint (node boot).
+        Publishes under the state lock so a concurrently starting epoch
+        pipeline never observes a half-restored warm snapshot; scores
+        and their peer-hash column are only installed as a pair."""
+        with self._state_lock:
+            if graph is not None:
+                self.last_graph = graph
+            if plan is not None:
+                self.window_plan = plan
+            if scores is not None and peer_hashes is not None:
+                self.last_scores = scores
+                self.last_peer_hashes = peer_hashes
 
     def build_graph(self) -> TrustGraph:
         """Assemble the open COO graph: peer ids are discovered from

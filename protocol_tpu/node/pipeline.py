@@ -107,18 +107,25 @@ class EpochPipeline:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "EpochPipeline":
-        if not self._started:
+        # The started flip happens under the condition lock: submit
+        # paths and close() race this from different roots, and a bare
+        # check-then-act here double-starts the worker thread.
+        with self._cv:
+            if self._started:
+                return self
             self._started = True
-            self._worker.start()
+        self._worker.start()
         return self
 
     def close(self, *, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop the device worker; with ``drain`` (default) only after
         every queued epoch has run."""
-        if drain and self._started:
+        with self._cv:
+            started = self._started
+        if drain and started:
             self.drain(timeout=timeout)
         self._stop.set()
-        if self._started:
+        if started:
             self._worker.join(timeout=timeout)
 
     def __enter__(self) -> "EpochPipeline":
@@ -135,8 +142,7 @@ class EpochPipeline:
         device: a full queue coalesces (the stale waiting epoch is
         superseded by this one), so a slow prover stretches epoch
         latency instead of backing work up or dropping ticks."""
-        if not self._started:
-            self.start()
+        self.start()  # idempotent under the condition lock
         prepared = self.manager.prepare_epoch(epoch)
         superseded: PreparedEpoch | None = None
         with self._cv:
@@ -151,9 +157,10 @@ class EpochPipeline:
                     pass
                 self._queue.put_nowait(prepared)
             self._pending += 1 if superseded is None else 0
+            if superseded is not None:
+                self.coalesced += 1
             obs_metrics.PIPELINE_QUEUE_DEPTH.set(self._queue.qsize())
         if superseded is not None:
-            self.coalesced += 1
             obs_metrics.EPOCH_TICKS_COALESCED.inc()
             JOURNAL.record(
                 "coalesced-tick",

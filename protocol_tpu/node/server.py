@@ -523,14 +523,17 @@ class Node:
         if snapshot.proof_json:
             proof = ProofRaw.from_json(snapshot.proof_json).to_proof()
             self.manager.cached_proofs[snapshot.epoch] = proof
-        self.manager.last_graph = snapshot.graph
-        self.manager.window_plan = snapshot.plan
         # Warm-start state: the checkpointed fixed point plus its
         # peer-hash column, so the first epoch after reboot converges
         # from near-fixed-point instead of cold (PERF.md §11).
-        if snapshot.scores is not None and snapshot.peer_hashes is not None:
-            self.manager.last_scores = snapshot.scores
-            self.manager.last_peer_hashes = snapshot.peer_hashes
+        # Published through the manager's state lock so a concurrently
+        # starting pipeline never sees a half-restored snapshot.
+        self.manager.restore_warm_state(
+            graph=snapshot.graph,
+            plan=snapshot.plan,
+            scores=snapshot.scores,
+            peer_hashes=snapshot.peer_hashes,
+        )
         log.info(
             "restored checkpoint: epoch %s, %d peers%s%s%s",
             snapshot.epoch,

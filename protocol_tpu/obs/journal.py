@@ -89,19 +89,23 @@ class FlightRecorder:
                 p.parent.mkdir(parents=True, exist_ok=True)
                 self._file = open(p, "a", encoding="utf-8")
                 self._path = p
-        if self._file is not None and (
-            self._writer is None or not self._writer.is_alive()
-        ):
-            self._stop.clear()
-            self._writer = threading.Thread(
-                target=self._writer_loop, name="flight-recorder", daemon=True
-            )
-            self._writer.start()
+            # Writer-thread lifecycle stays under the same lock as the
+            # file handle: configure() and close() race from different
+            # roots (node boot, SIGTERM handler, tests).
+            if self._file is not None and (
+                self._writer is None or not self._writer.is_alive()
+            ):
+                self._stop.clear()
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="flight-recorder", daemon=True
+                )
+                self._writer.start()
         return self
 
     @property
     def path(self) -> Path | None:
-        return self._path
+        with self._io_lock:
+            return self._path
 
     # -- hot path -------------------------------------------------------
 
@@ -206,9 +210,13 @@ class FlightRecorder:
         """Flush pending events and stop the writer thread."""
         self._stop.set()
         self._wake.set()
-        if self._writer is not None:
-            self._writer.join(timeout=5.0)
-            self._writer = None
+        # Swap under the lock, join outside it: holding _io_lock across
+        # the join would stall flush() (and trip pass 7's
+        # blocking-call-under-lock rule) for the whole drain.
+        with self._io_lock:
+            writer, self._writer = self._writer, None
+        if writer is not None:
+            writer.join(timeout=5.0)
         self.flush()
         with self._io_lock:
             if self._file is not None:

@@ -419,6 +419,14 @@ INGEST_WORKER_RESTARTS = METRICS.counter(
     "eigentrust_ingest_worker_restarts_total",
     "Verify worker-pool rebuilds after a worker process died",
 )
+LOCK_WAIT_SECONDS = METRICS.histogram(
+    "eigentrust_lock_wait_seconds",
+    "Lock-acquisition wait time by allocation site — recorded only "
+    "under the opt-in lock-witness debug mode "
+    "(analysis/concurrency/witness.py); absent on a production node",
+    labelnames=("site",),
+    buckets=(1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0),
+)
 
 __all__ = [
     "Counter",
@@ -461,4 +469,5 @@ __all__ = [
     "INGEST_ADMISSION_SECONDS",
     "INGEST_VERIFY_BATCHES",
     "INGEST_WORKER_RESTARTS",
+    "LOCK_WAIT_SECONDS",
 ]

@@ -144,6 +144,10 @@ class MemoryWatermarkWatcher:
     state on unsupported platforms is two no-op attribute reads."""
 
     def __init__(self) -> None:
+        #: Guards the probe verdict: span hooks fire from every root
+        #: that opens spans (epoch executor, pipeline device worker,
+        #: ingest threads), so the first-probe flip must not race.
+        self._probe_lock = threading.Lock()
         self._enabled: bool | None = None  # None = not probed yet
 
     def _devices(self):
@@ -166,18 +170,20 @@ class MemoryWatermarkWatcher:
         )
 
     def on_open(self, span) -> None:
-        if self._enabled is False:
-            return
+        with self._probe_lock:
+            if self._enabled is False:
+                return
         snap = self._bytes_in_use()
+        with self._probe_lock:
+            self._enabled = snap is not None
         if snap is None:
-            self._enabled = False
             return
-        self._enabled = True
         span.attrs["_mem_open_bytes"] = snap[0]
 
     def on_close(self, span) -> None:
-        if self._enabled is not True:
-            return
+        with self._probe_lock:
+            if self._enabled is not True:
+                return
         opened = span.attrs.pop("_mem_open_bytes", None)
         if opened is None:
             return

@@ -9,6 +9,7 @@ Every caller has a pure-Python fallback gated on ``available()``.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 from pathlib import Path
 
@@ -25,7 +26,13 @@ from ..utils.limbs import (
 )
 from .bn254 import G1
 
-_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+#: PROTOCOL_TPU_NATIVE_DIR points the loader at an alternate build
+#: (the sanitizer wall's instrumented variants — tools/sanitize_native.py).
+_NATIVE_DIR = (
+    Path(os.environ["PROTOCOL_TPU_NATIVE_DIR"]).resolve()
+    if os.environ.get("PROTOCOL_TPU_NATIVE_DIR")
+    else Path(__file__).resolve().parents[2] / "native"
+)
 _LIB_PATH = _NATIVE_DIR / "libzk_runtime.so"
 _lib = None  # None = untried, False = failed, else CDLL
 

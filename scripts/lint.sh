@@ -12,9 +12,14 @@ cd "$(dirname "$0")/.."
 python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.py
 
 # graftlint: pass 1 traces every registered backend's step to a jaxpr
-# and checks its declared KERNEL_INVARIANTS budget; pass 2 is the AST
-# ruleset over protocol_tpu/.  Any error-severity finding fails here.
-# Emits ANALYSIS.json (uploaded as a CI artifact).
+# and checks its declared KERNEL_INVARIANTS budget; passes 2-6 are the
+# AST ruleset over protocol_tpu/; pass 7 is the whole-program
+# concurrency analyzer (thread-root discovery, shared-state guard
+# inference, lock-order cycles, blocking/native-under-lock) with its
+# enumerated waiver table.  Any error-severity finding — including an
+# unwaived concurrency finding — fails here.  Emits ANALYSIS.json
+# (uploaded as a CI artifact; the concurrency section carries the root
+# inventory, guard map, lock graph, and waiver list).
 python -m protocol_tpu.analysis --output ANALYSIS.json
 
 # Trees held to the hard format/type gates: the convergence-kernel,

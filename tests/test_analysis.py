@@ -60,9 +60,9 @@ class TestViolationFixtures:
         finding = errors[0]
         if fixture.marker is None:
             return
-        if fixture.kind == "ast":
-            # Pass-3 fixtures carry their violating code as a source
-            # string (so the repo-wide AST pass never sees it); the
+        if fixture.kind in ("ast", "concurrency"):
+            # String-sourced fixtures carry their violating code as a
+            # source string (so the repo-wide passes never see it); the
             # finding anchors inside that string at the marker line.
             source, rel_path = fixture.build()
             marker_line = next(
@@ -96,11 +96,18 @@ class TestViolationFixtures:
 
 @pytest.fixture(scope="module")
 def real_report(tmp_path_factory):
-    """One full two-pass run over the real tree (module-scoped: the
-    jaxpr pass traces all six backends)."""
+    """One full all-pass run over the real tree (module-scoped: the
+    jaxpr pass traces all six backends).  Wall time rides along under
+    ``_wall_s`` for the analyzer self-budget test."""
+    import time
+
     out = tmp_path_factory.mktemp("analysis") / "ANALYSIS.json"
+    t0 = time.perf_counter()
     rc = analysis_main(["--output", str(out)])
-    return rc, json.loads(out.read_text())
+    wall = time.perf_counter() - t0
+    report = json.loads(out.read_text())
+    report["_wall_s"] = wall
+    return rc, report
 
 
 class TestRealTree:
@@ -204,6 +211,207 @@ class TestBudgetRules:
         )
         findings = check_case(budget, case)
         assert "psum-count" in {f.rule for f in findings}
+
+
+class TestConcurrencyPass:
+    """Pass 7: the whole-program concurrency analyzer (ISSUE 8)."""
+
+    def test_real_tree_zero_unwaived_findings(self, real_report):
+        _, report = real_report
+        conc = [f for f in report["findings"] if f["pass"] == "concurrency"]
+        assert conc == [], conc
+
+    def test_waivers_enumerated_and_live(self, real_report):
+        """Every waiver is visible in the report AND still matches a
+        live finding — a fixed bug must take its waiver with it (zero
+        silent suppressions, zero stale entries)."""
+        from protocol_tpu.analysis.concurrency import WAIVERS
+
+        _, report = real_report
+        section = report["concurrency"]
+        assert section["stale_waivers"] == [], section["stale_waivers"]
+        matched = {w["symbol"] for w in section["waived"]}
+        assert {w.symbol for w in WAIVERS} == matched
+
+    def test_roots_cover_known_threads(self, real_report):
+        """The root inventory finds the node's actual execution roots:
+        the pipeline device worker, the journal writer, the ingest
+        stage threads, the HTTP handler tree, and the signal handler."""
+        _, report = real_report
+        roots = {r["name"] for r in report["concurrency"]["roots"]}
+        for expected in (
+            "thread:epoch-pipeline-device",
+            "thread:flight-recorder",
+            "thread:ingest-admission",
+            "http-handler",
+            "signal-handler",
+            "asyncio-task",
+            "executor-submit",
+            "main",
+        ):
+            assert expected in roots, (expected, sorted(roots))
+
+    def test_guard_map_covers_fixed_state(self, real_report):
+        """The attributes fixed in this PR are inferred as guarded —
+        the static half of the witness cross-check."""
+        _, report = real_report
+        guarded = report["concurrency"]["guarded_attrs"]
+        for attr, lock in (
+            ("Manager._dirty_hashes", "Manager._state_lock"),
+            ("Manager.last_scores", "Manager._state_lock"),
+            ("Manager.last_peer_hashes", "Manager._state_lock"),
+            ("Manager.window_plan", "Manager._state_lock"),
+            ("EpochPipeline.coalesced", "EpochPipeline._cv"),
+            ("EpochPipeline._started", "EpochPipeline._cv"),
+            ("IngestPlane.accepted", "IngestPlane._cv"),
+            ("IngestPlane.shed", "IngestPlane._cv"),
+            ("MemoryWatermarkWatcher._enabled", "MemoryWatermarkWatcher._probe_lock"),
+            ("FlightRecorder._writer", "FlightRecorder._io_lock"),
+        ):
+            assert guarded.get(attr) == [lock], (attr, guarded.get(attr))
+
+    def test_analyzer_self_budget(self, real_report):
+        """Full-tree graftlint (all passes, backends traced) stays
+        under 60 s — the gate must remain cheap enough to run hard on
+        every lint."""
+        _, report = real_report
+        assert report["_wall_s"] < 60.0, report["_wall_s"]
+
+    # -- precision negatives -------------------------------------------
+
+    def test_readonly_reference_not_flagged(self):
+        """A never-reassigned reference to a thread-safe object (the
+        bounded-queue pattern) needs no guard."""
+        from protocol_tpu.analysis.concurrency import analyze_sources
+
+        src = (
+            "import queue\nimport threading\n\n\n"
+            "class Plane:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._queue = queue.Queue(maxsize=4)\n\n"
+            "    def producer(self):\n"
+            "        with self._lock:\n"
+            "            self._queue.put_nowait(1)\n\n"
+            "    def consumer(self):\n"
+            "        return self._queue.get(timeout=0.05)\n\n\n"
+            "def run():\n"
+            "    p = Plane()\n"
+            "    threading.Thread(target=p.producer).start()\n"
+            "    threading.Thread(target=p.consumer).start()\n"
+        )
+        assert analyze_sources({"protocol_tpu/node/_x.py": src}) == []
+
+    def test_confined_tree_is_quiet(self):
+        """The same RMW that fires in node/ is policy-quiet in zk/:
+        prover objects are thread-confined by design."""
+        from protocol_tpu.analysis.concurrency import analyze_sources
+
+        src = (
+            "import threading\n\n\n"
+            "class Hits:\n"
+            "    def __init__(self):\n"
+            "        self.n = 0\n\n"
+            "    def work(self):\n"
+            "        self.n += 1\n\n\n"
+            "def run():\n"
+            "    h = Hits()\n"
+            "    threading.Thread(target=h.work, name='a').start()\n"
+            "    threading.Thread(target=h.work, name='b').start()\n"
+        )
+        assert analyze_sources({"protocol_tpu/zk/_x.py": src}) == []
+        assert analyze_sources({"protocol_tpu/node/_x.py": src}) != []
+
+    def test_bounded_put_under_lock_ok(self):
+        from protocol_tpu.analysis.concurrency import analyze_sources
+
+        src = (
+            "import queue\nimport threading\n\n\n"
+            "class Stage:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._queue = queue.Queue(maxsize=4)\n\n"
+            "    def push(self, item):\n"
+            "        with self._lock:\n"
+            "            self._queue.put(item, timeout=0.05)\n"
+        )
+        assert analyze_sources({"protocol_tpu/node/_x.py": src}) == []
+
+    def test_locked_helper_inherits_guard(self):
+        """A helper only ever called under the lock inherits the guard
+        (the journal's _rotate_locked pattern must not false-positive)."""
+        from protocol_tpu.analysis.concurrency import analyze_sources
+
+        src = (
+            "import threading\n\n\n"
+            "class Store:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.state = 0\n\n"
+            "    def mutate(self):\n"
+            "        with self._lock:\n"
+            "            self.state += 1\n"
+            "            self._bump_locked()\n\n"
+            "    def _bump_locked(self):\n"
+            "        self.state += 1\n\n\n"
+            "def run():\n"
+            "    s = Store()\n"
+            "    threading.Thread(target=s.mutate).start()\n"
+            "    threading.Thread(target=s.mutate).start()\n"
+        )
+        assert analyze_sources({"protocol_tpu/node/_x.py": src}) == []
+
+    def test_consistent_lock_order_no_cycle(self):
+        from protocol_tpu.analysis.concurrency import analyze_sources
+
+        src = (
+            "import threading\n\n\n"
+            "class Transfer:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n\n"
+            "    def ab(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n\n"
+            "    def ab2(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+        )
+        assert analyze_sources({"protocol_tpu/node/_x.py": src}) == []
+
+    def test_transitive_lock_cycle_through_call(self):
+        """A cycle built through a call made under a held lock is still
+        a cycle — the order graph follows same-class calls."""
+        from protocol_tpu.analysis.concurrency import analyze_sources
+
+        src = (
+            "import threading\n\n\n"
+            "class Transfer:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n\n"
+            "    def ab(self):\n"
+            "        with self._a:\n"
+            "            self.take_b()\n\n"
+            "    def take_b(self):\n"
+            "        with self._b:\n"
+            "            pass\n\n"
+            "    def ba(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        )
+        findings = analyze_sources({"protocol_tpu/node/_x.py": src})
+        assert [f.rule for f in findings] == ["lock-order-cycle"]
+
+    def test_concurrency_section_in_report(self, real_report):
+        _, report = real_report
+        section = report["concurrency"]
+        assert section["classes_analyzed"] > 40
+        assert "protocol_tpu/zk/" in section["confined_trees"]
+        assert section["findings"] == 0
 
 
 def _scan(tmp_path: Path, rel: str, code: str):

@@ -209,3 +209,68 @@ class TestNoHistory:
             ["--history", str(tmp_path), "--out", str(tmp_path / "s.json")]
         )
         assert rc == 2
+
+
+class TestSiblingArtifactsIgnored:
+    """ISSUE 8: SANITIZER/ANALYSIS artifacts living beside the bench
+    rounds (or caught by an over-broad --glob) are skipped gracefully —
+    never mined for numbers, never a parse failure."""
+
+    def _sanitizer_doc(self) -> dict:
+        return {
+            "mode": "asan",
+            "sanflags": "-fsanitize=address,undefined",
+            "build_rc": 0,
+            "runs": [{"name": "native-test-subset", "rc": 0}],
+            "reports": [],
+            "ok": True,
+        }
+
+    def _analysis_doc(self) -> dict:
+        return {
+            "version": 1,
+            "tool": "protocol_tpu.analysis (graftlint)",
+            "summary": {"error": 0},
+            "findings": [
+                {"pass": "concurrency", "rule": "unguarded-rmw", "line": 42}
+            ],
+            "concurrency": {"roots": [], "findings": 0},
+        }
+
+    def test_artifacts_beside_rounds_do_not_pollute_series(self, tmp_path):
+        _write_rounds(tmp_path, [10.0, 9.5])
+        (tmp_path / "SANITIZER_asan_r01.json").write_text(
+            json.dumps(self._sanitizer_doc())
+        )
+        (tmp_path / "ANALYSIS_r01.json").write_text(
+            json.dumps(self._analysis_doc())
+        )
+        out = tmp_path / "s.json"
+        rc = perf_sentinel.main(
+            ["--history", str(tmp_path), "--glob", "*_r*.json", "--out", str(out)]
+        )
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert report["ok"] is True
+        # Exactly the bench series — nothing mined from the artifacts.
+        assert list(report["series"]) == ["headline seconds :: value"]
+        assert report["series"]["headline seconds :: value"]["rounds"] == 2
+
+    def test_artifact_only_history_is_empty_not_crash(self, tmp_path):
+        (tmp_path / "SANITIZER_tsan_r01.json").write_text(
+            json.dumps(self._sanitizer_doc() | {"mode": "tsan"})
+        )
+        out = tmp_path / "s.json"
+        rc = perf_sentinel.main(
+            ["--history", str(tmp_path), "--glob", "*_r*.json", "--out", str(out)]
+        )
+        assert rc == 0
+        assert json.loads(out.read_text())["series"] == {}
+
+    def test_committed_sanitizer_rounds_are_ignored_by_defaults(self):
+        """The repo now commits SANITIZER_*_r01.json next to BENCH_r*;
+        the default-glob run must not pick them up."""
+        series = perf_sentinel.collect_series(
+            [REPO / "SANITIZER_asan_r01.json", REPO / "SANITIZER_tsan_r01.json"]
+        )
+        assert series == {}

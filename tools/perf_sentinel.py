@@ -64,6 +64,19 @@ def _lower_is_better(field: str, entry: dict[str, Any]) -> bool | None:
     return None  # unknown: not gated
 
 
+def _is_non_bench_artifact(obj: Any) -> bool:
+    """True for sibling CI artifacts that are not bench rounds — the
+    graftlint report (ANALYSIS*.json: "tool" + "findings") and the
+    sanitizer wall (SANITIZER*.json: "sanflags"/"mode" + "runs").
+    They may land in the history directory (or an over-broad --glob);
+    the sentinel skips them instead of mining them for numbers."""
+    if not isinstance(obj, dict):
+        return False
+    if "tool" in obj and "findings" in obj:
+        return True
+    return "runs" in obj and ("sanflags" in obj or "mode" in obj)
+
+
 def _entries(obj: Any) -> Iterator[dict[str, Any]]:
     """Every bench entry inside one parsed JSON document: driver
     records ({"parsed": {...}}), ladder reports ({"ladder": [...]}),
@@ -104,6 +117,12 @@ def collect_series(paths: list[Path]) -> dict[str, list[dict[str, Any]]]:
             obj = json.loads(path.read_text())
         except (OSError, json.JSONDecodeError) as exc:
             print(f"perf_sentinel: skipping {path}: {exc}", file=sys.stderr)
+            continue
+        if _is_non_bench_artifact(obj):
+            print(
+                f"perf_sentinel: ignoring non-bench artifact {path.name}",
+                file=sys.stderr,
+            )
             continue
         rnd = _round_of(path, obj)
         for entry in _entries(obj):

@@ -1,0 +1,265 @@
+"""Sanitizer wall for the native runtime (ISSUE 8 satellite).
+
+Builds ``native/`` with compiler sanitizers into a side directory
+(``native/sanitize/<mode>``), points the Python loaders at it via
+``PROTOCOL_TPU_NATIVE_DIR``, and runs the native-touching workload
+under the instrumented libraries:
+
+- ``--mode asan`` — AddressSanitizer + UBSan over the native-touching
+  test subset (field/runtime parity suites) plus the parallel driver.
+- ``--mode tsan`` — ThreadSanitizer over the parallel driver: the
+  OpenMP batch-verify region, the MSM/NTT parallel regions, and the
+  relaxed-atomic phase-timer table (PR 6) hammered from concurrent
+  Python threads.  ``native/tsan.supp`` suppresses the known libgomp
+  runtime-internal reports (GCC's libgomp is not TSAN-instrumented;
+  its barrier/teardown internals are runtime noise, not our code) —
+  every suppression is enumerated in the report.
+
+Reports land in ``SANITIZER.json`` (the CI artifact): build/run exit
+codes, every sanitizer report captured via ``log_path``, and the
+suppression list in force.  Exit 0 iff the build succeeded, the
+workload passed, and no unsuppressed report fired.
+
+Run::
+
+    python tools/sanitize_native.py --mode asan --out SANITIZER.json
+    python tools/sanitize_native.py --mode tsan --out SANITIZER_tsan.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+
+#: Native-touching test subset for the ASAN/UBSAN leg: the ctypes
+#: parity suites that drive every exported symbol with real data.
+ASAN_TESTS = [
+    "tests/test_native_field.py",
+    "tests/test_native_runtime.py",
+]
+
+MODES = {
+    "asan": {
+        "sanout": "sanitize/asan",
+        "sanflags": "-fsanitize=address,undefined -fno-sanitize-recover=undefined",
+        "libs": ["libasan.so", "libubsan.so"],
+    },
+    "tsan": {
+        "sanout": "sanitize/tsan",
+        "sanflags": "-fsanitize=thread",
+        "libs": ["libtsan.so"],
+    },
+}
+
+
+def _preload_paths(libs: list[str], cxx: str) -> list[str]:
+    out = []
+    for lib in libs:
+        p = subprocess.run(
+            [cxx, f"-print-file-name={lib}"], capture_output=True, text=True
+        ).stdout.strip()
+        if p and p != lib and Path(p).exists():
+            out.append(str(Path(p).resolve()))
+    return out
+
+
+def _build(mode: dict) -> int:
+    return subprocess.run(
+        [
+            "make",
+            "-C",
+            str(NATIVE),
+            "sanitized",
+            f"SANOUT={mode['sanout']}",
+            f"SANFLAGS={mode['sanflags']}",
+        ],
+    ).returncode
+
+
+def _driver() -> None:
+    """The parallel workload (runs in the instrumented subprocess):
+    hammer every OpenMP region and the relaxed-atomic phase timers
+    from concurrent threads."""
+    import threading
+
+    from protocol_tpu.crypto import calculate_message_hash
+    from protocol_tpu.crypto import native as cnative
+    from protocol_tpu.crypto.eddsa import sign
+    from protocol_tpu.node.bootstrap import FIXED_SET, keyset_from_raw
+    from protocol_tpu.zk import native as zknative
+    from protocol_tpu.zk.bn254 import GENERATOR
+
+    assert cnative.available(), "instrumented libprotocol_native failed to load"
+    assert zknative.available(), "instrumented libzk_runtime failed to load"
+
+    sks, pks = keyset_from_raw(FIXED_SET)
+    rows = [[200 + (i % 190), 200 - (i % 190), 200, 200, 200] for i in range(64)]
+    _, msgs = calculate_message_hash(pks, rows)
+    sigs = [sign(sks[i % len(sks)], pks[i % len(pks)], m) for i, m in enumerate(msgs)]
+
+    def batch_verify():
+        ok = cnative.eddsa_verify_batch(
+            [s.big_r.x for s in sigs],
+            [s.big_r.y for s in sigs],
+            [s.s for s in sigs],
+            [pks[i % len(pks)].point.x for i in range(len(sigs))],
+            [pks[i % len(pks)].point.y for i in range(len(sigs))],
+            msgs,
+        )
+        assert all(ok), "batch verify rejected a valid signature"
+
+    def zk_hot_loops():
+        # MSM + NTT parallel regions, ~2^10 scale so TSAN finishes fast.
+        n = 1 << 10
+        scalars = [(i * 2654435761 + 1) % zknative.R for i in range(n)]
+        points = [GENERATOR.mul((i % 7) + 1) for i in range(64)] * (n // 64)
+        zknative.msm(scalars, points)
+        root = pow(5, (zknative.R - 1) // n, zknative.R)
+        zknative.ntt(scalars, root)
+        zknative.batch_inv(scalars[: 1 << 8])
+
+    def phase_timers():
+        # The PR 6 relaxed-atomic table, read while the hot loops write.
+        for _ in range(200):
+            zknative.phase_stats()
+            zknative.reset_phase_stats()
+
+    failures: list[BaseException] = []
+
+    def run(fn):
+        def wrapped():
+            try:
+                fn()
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                failures.append(exc)
+
+        return wrapped
+
+    threads = (
+        [threading.Thread(target=run(batch_verify)) for _ in range(2)]
+        + [threading.Thread(target=run(zk_hot_loops)) for _ in range(2)]
+        + [threading.Thread(target=run(phase_timers))]
+    )
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if failures:
+        raise failures[0]
+    print("sanitize driver: all parallel regions exercised")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=sorted(MODES), default="asan")
+    ap.add_argument("--out", default="SANITIZER.json")
+    ap.add_argument(
+        "--driver", action="store_true", help=argparse.SUPPRESS
+    )  # internal: the instrumented child process
+    args = ap.parse_args(argv)
+
+    if args.driver:
+        sys.path.insert(0, str(REPO))  # invoked as a script from tools/
+        _driver()
+        return 0
+
+    mode = MODES[args.mode]
+    cxx = os.environ.get("CXX", "g++")
+    report: dict = {"mode": args.mode, "sanflags": mode["sanflags"]}
+
+    build_rc = _build(mode)
+    report["build_rc"] = build_rc
+    if build_rc != 0:
+        report["ok"] = False
+        Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+        print(f"sanitize_native: build failed (rc={build_rc})", file=sys.stderr)
+        return 1
+
+    log_dir = REPO / f"sanitize-logs-{args.mode}"
+    log_dir.mkdir(exist_ok=True)
+    for old in glob.glob(str(log_dir / "*")):
+        os.unlink(old)
+    log_prefix = str(log_dir / "report")
+
+    env = dict(os.environ)
+    env["PROTOCOL_TPU_NATIVE_DIR"] = str(NATIVE / mode["sanout"])
+    env["JAX_PLATFORMS"] = "cpu"
+    preloads = _preload_paths(mode["libs"], cxx)
+    if preloads:
+        env["LD_PRELOAD"] = ":".join(
+            preloads + [p for p in env.get("LD_PRELOAD", "").split(":") if p]
+        )
+    report["preload"] = preloads
+    suppressions = NATIVE / "tsan.supp"
+    if args.mode == "asan":
+        # Python itself "leaks" interned state by design; leak checking
+        # the interpreter drowns real reports.
+        env["ASAN_OPTIONS"] = f"detect_leaks=0:log_path={log_prefix}"
+        env["UBSAN_OPTIONS"] = f"print_stacktrace=1:log_path={log_prefix}"
+    else:
+        env["TSAN_OPTIONS"] = (
+            f"suppressions={suppressions}:log_path={log_prefix}:exitcode=66"
+        )
+        report["suppressions"] = (
+            suppressions.read_text().splitlines() if suppressions.exists() else []
+        )
+
+    runs: list[dict] = []
+    if args.mode == "asan":
+        runs.append(
+            {
+                "name": "native-test-subset",
+                "cmd": [sys.executable, "-m", "pytest", "-q", *ASAN_TESTS],
+            }
+        )
+    runs.append(
+        {
+            "name": "parallel-driver",
+            "cmd": [sys.executable, str(Path(__file__)), "--driver"],
+        }
+    )
+
+    ok = True
+    report["runs"] = []
+    for run in runs:
+        rc = subprocess.run(run["cmd"], cwd=REPO, env=env).returncode
+        report["runs"].append({"name": run["name"], "rc": rc})
+        ok = ok and rc == 0
+
+    reports = []
+    for path in sorted(glob.glob(log_prefix + "*")):
+        text = Path(path).read_text()
+        reports.append(
+            {
+                "file": Path(path).name,
+                "summary": [
+                    line
+                    for line in text.splitlines()
+                    if line.startswith(("SUMMARY:", "WARNING:", "ERROR:"))
+                ][:10],
+                "text": text[:20000],
+            }
+        )
+    report["reports"] = reports
+    report["ok"] = ok and not reports
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    status = "clean" if report["ok"] else "FINDINGS"
+    print(
+        f"sanitize_native[{args.mode}]: {status} — "
+        f"{len(reports)} report file(s), runs="
+        + ", ".join(f"{r['name']}:{r['rc']}" for r in report["runs"])
+        + f" ({args.out} written)"
+    )
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
