@@ -158,6 +158,30 @@ def headline_entry(
             "mesh_shards": int(mesh.shape[SHARD_AXIS]),
             "rows_per_shard": swp.rows_per_shard,
         }
+        # Pass-8 comm scrape (PERF.md §15): per-iteration collective
+        # byte volume of the exact module this bench executes, recorded
+        # into the LADDER round so tools/perf_sentinel.py tracks it as
+        # a comm_bytes_per_iter series.  AOT-compiled once, outside the
+        # timed region.
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from protocol_tpu.analysis.comm.hlo_walk import parse_module
+        from protocol_tpu.parallel.sharded import _get_windowed_runner
+
+        runner = _get_windowed_runner(
+            mesh, swp.n, swp.rows_per_shard, swp.table_entries, swp.interpret
+        )
+        alpha_repl = jax.device_put(np.float32(0.1), NamedSharding(mesh, P()))
+        mod = parse_module(
+            runner.lower(
+                swp.wid, swp.local, swp.weight, swp.seg_end, swp.seg_first,
+                swp.seg_perm, swp.dst_ptr, swp.t0(), swp.p, swp.dangling,
+                alpha_repl, max_iter=iters, tol=0.0,
+            ).compile().as_text()
+        )
+        extra["comm_bytes_per_iter"] = mod.total_bytes(per_iteration_only=True)
+        extra["comm_collectives"] = mod.kind_counts()
 
         def run():
             t, it, resid = converge_sharded(swp, alpha=0.1, tol=0.0, max_iter=iters)

@@ -13,6 +13,14 @@ This subsystem checks them by machine:
 - **Pass 2** (``ast_rules``): an ``ast.NodeVisitor`` ruleset over
   ``protocol_tpu/`` catching implicit host syncs and import-time
   device work.
+- **Pass 7** (``concurrency``): the whole-program threading-contract
+  analyzer with its enumerated, stale-tested waiver table.
+- **Pass 8** (``comm``): the SPMD-lowering communication analyzer —
+  compiles every backend under the 8-device CPU mesh and checks the
+  declarative :data:`~protocol_tpu.analysis.budget.COMM_INVARIANTS`
+  budgets (collective kinds/counts, O(boundary + N) byte allowances
+  evaluated at two scales, host round-trips, donation aliasing) against
+  what the partitioner actually emitted.
 
 Run as ``python -m protocol_tpu.analysis``: emits ``ANALYSIS.json``
 plus ``file:line`` findings; any error-severity finding exits non-zero
@@ -26,15 +34,22 @@ only when invoked.
 """
 
 from .budget import (
+    COMM_INVARIANTS,
     KERNEL_INVARIANTS,
     NON_JAX_BACKENDS,
+    CollectiveBudget,
+    CommBudget,
     GatherBudget,
     KernelBudget,
     declare,
+    declare_comm,
 )
 from .report import Finding, Report
 
 __all__ = [
+    "COMM_INVARIANTS",
+    "CollectiveBudget",
+    "CommBudget",
     "Finding",
     "GatherBudget",
     "KERNEL_INVARIANTS",
@@ -42,4 +57,5 @@ __all__ = [
     "NON_JAX_BACKENDS",
     "Report",
     "declare",
+    "declare_comm",
 ]

@@ -41,7 +41,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--pass",
         dest="passes",
-        choices=("all", "jaxpr", "ast", "concurrency"),
+        choices=("all", "jaxpr", "ast", "concurrency", "comm"),
         default="all",
         help="which pass(es) to run (default: %(default)s)",
     )
@@ -98,6 +98,12 @@ def main(argv: list[str] | None = None) -> int:
             findings, section = run_concurrency_pass()
             report.extend(findings)
             report.concurrency = section
+        if args.passes in ("all", "comm"):
+            from .comm import run_comm_pass
+
+            findings, section = run_comm_pass()
+            report.extend(findings)
+            report.comm = section
 
     report.write_json(args.output)
     print(report.render())

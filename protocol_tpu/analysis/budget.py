@@ -106,10 +106,110 @@ def declare(budget: KernelBudget) -> KernelBudget:
     return budget
 
 
+# ---------------------------------------------------------------------------
+# Communication budgets — the ``COMM_INVARIANTS`` table (graftlint pass 8)
+# ---------------------------------------------------------------------------
+
+#: Collective kinds the SPMD partitioner can emit, as spelled in HLO.
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+
+@dataclass(frozen=True)
+class CollectiveBudget:
+    """Allowance for one collective kind in the lowered module.
+
+    ``max_count`` caps the number of ops of this kind anywhere in the
+    compiled module (the power-iteration body runs once per step, so a
+    static op in the loop body IS the per-iteration count); a kind with
+    no :class:`CollectiveBudget` entry is forbidden outright — a
+    partitioner-introduced all-gather must be declared, never silent.
+    """
+
+    kind: str  # one of COLLECTIVE_KINDS
+    max_count: int
+
+
+@dataclass(frozen=True)
+class CommBudget:
+    """Per-backend communication contract checked by pass 8 against the
+    *compiled* (SPMD-partitioned) module, not the jaxpr.
+
+    The byte budget is deliberately declarative-linear: the allowance
+    for per-iteration collective traffic is ``bytes_n * N +
+    bytes_segments * n_segments + bytes_shards * n_shards +
+    bytes_const``.  An O(E) term is structurally inexpressible, and the
+    analyzer still *evaluates* the budget against measured bytes at two
+    problem scales where E grows 4x while N grows 2x — so an O(E)
+    lowering cannot hide inside a padded constant either (the sparse
+    power-method scaling argument of arXiv:2105.03874: communication
+    must follow boundary + N, never edges).
+    """
+
+    backend: str
+    #: Allowed collective kinds and per-module op-count caps; kinds
+    #: absent from this tuple are forbidden in the lowering.
+    collectives: tuple[CollectiveBudget, ...] = ()
+    #: Linear coefficients of the per-iteration collective byte budget.
+    bytes_n: float = 0.0
+    bytes_segments: float = 0.0
+    bytes_shards: float = 0.0
+    bytes_const: float = 0.0
+    #: Host round-trips (infeed/outfeed/send/recv/host-callback
+    #: custom-calls) permitted in the compiled module.
+    max_host_round_trips: int = 0
+    #: Arguments whose donation must survive all the way into the
+    #: compiled module's ``input_output_alias`` table (a dropped alias
+    #: doubles peak HBM at the 1M-peer shape and ships silently).
+    donated_args: tuple[str, ...] = ()
+    #: Free-form rationale recorded in ANALYSIS.json.
+    notes: str = ""
+
+    def max_bytes(self, n: int, n_segments: int, n_shards: int) -> float:
+        """Evaluate the linear byte budget at one problem scale."""
+        return (
+            self.bytes_n * n
+            + self.bytes_segments * n_segments
+            + self.bytes_shards * n_shards
+            + self.bytes_const
+        )
+
+    def allowed_count(self, kind: str) -> int:
+        for cb in self.collectives:
+            if cb.kind == kind:
+                return cb.max_count
+        return 0
+
+
+#: backend name -> declared comm budget.  Populated by kernel modules
+#: at import (next to their KERNEL_INVARIANTS declarations); read by
+#: ``protocol_tpu.analysis.comm`` and cross-checked against the
+#: ``trust/backend.py`` registry — a registered jax backend without an
+#: entry is an error, the same policy as kernel budgets.
+COMM_INVARIANTS: dict[str, CommBudget] = {}
+
+
+def declare_comm(budget: CommBudget) -> CommBudget:
+    """Register a comm budget (idempotent per backend name; kernel
+    modules call this at import time, next to ``declare``)."""
+    COMM_INVARIANTS[budget.backend] = budget
+    return budget
+
+
 __all__ = [
+    "COLLECTIVE_KINDS",
+    "COMM_INVARIANTS",
+    "CollectiveBudget",
+    "CommBudget",
     "GatherBudget",
     "KernelBudget",
     "KERNEL_INVARIANTS",
     "NON_JAX_BACKENDS",
     "declare",
+    "declare_comm",
 ]

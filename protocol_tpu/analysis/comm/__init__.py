@@ -1,0 +1,53 @@
+"""graftlint pass 8 — the SPMD-lowering communication analyzer.
+
+ROADMAP item 3 (multi-host pod scale-out) lives or dies on
+communication that scales with boundary segments, not edges.  Passes
+1–7 pin the jaxpr and the host program; this pass pins the layer in
+between that nothing else sees: what the SPMD partitioner actually
+emits when it compiles the sharded step.  A replicated-operand
+rebroadcast, a surprise all-gather, or a silently dropped donation
+alias would pass every existing gate and only surface as a wall at pod
+scale — exactly the class of bug pass 1 closed for single-device
+kernels.
+
+- :mod:`lowering` compiles every registered backend's converge entry
+  under the 8-device CPU mesh (sharded composites at two problem
+  scales, E x4 vs N x2);
+- :mod:`hlo_walk` parses the compiled module: collectives with replica
+  groups and byte volumes from operand shapes, host round-trips, and
+  the ``input_output_alias`` table;
+- :mod:`checker` judges each module against the declarative
+  :data:`~protocol_tpu.analysis.budget.COMM_INVARIANTS` budget declared
+  next to the kernel (linear ``O(boundary + N)`` byte allowances — an
+  O(E) term is structurally inexpressible *and* caught at the second
+  scale), cross-checks jaxpr psums against lowered all-reduces, and
+  emits the ``comm`` section of ANALYSIS.json;
+- :mod:`waivers` is the enumerated, stale-tested suppression table
+  (pass-7 doctrine; currently empty).
+
+``tools/comm_probe.py`` is the runtime counterpart: a 2-process
+``jax.distributed`` CPU smoke that runs one sharded converge and
+asserts the measured collective structure is a subset of these static
+budgets — the first executable artifact of the multi-host path.
+"""
+
+from __future__ import annotations
+
+from .checker import check_comm_case, run_comm_pass
+from .hlo_walk import CollectiveOp, HostCall, ModuleComm, parse_module
+from .lowering import COMM_BUILDERS, COMM_SCALES, CommCase, build_cases
+from .waivers import COMM_WAIVERS
+
+__all__ = [
+    "COMM_BUILDERS",
+    "COMM_SCALES",
+    "COMM_WAIVERS",
+    "CollectiveOp",
+    "CommCase",
+    "HostCall",
+    "ModuleComm",
+    "build_cases",
+    "check_comm_case",
+    "parse_module",
+    "run_comm_pass",
+]

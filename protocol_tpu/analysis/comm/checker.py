@@ -1,0 +1,317 @@
+"""Pass 8 — the SPMD-lowering communication analyzer.
+
+Pass 1 proved the *jaxpr* does one psum under shard_map; this pass
+proves the **partitioner kept that promise**.  For every registered
+backend it compiles the converge entry point under the 8-device CPU
+mesh (``comm.lowering``), walks the compiled module (``comm.hlo_walk``),
+and checks the declarative
+:data:`~protocol_tpu.analysis.budget.COMM_INVARIANTS` budget the
+kernel module declared:
+
+- **collective-kind** — a collective kind the budget does not allow at
+  all (the classic partitioner surprise: a replicated-operand
+  rebroadcast materializing as an all-gather);
+- **collective-count** — more ops of an allowed kind than budgeted;
+- **comm-bytes-budget** — per-iteration collective bytes (computed
+  from operand/result shapes) exceed the linear ``O(boundary + N)``
+  budget, evaluated at every compiled scale — the sharded composites
+  compile at two scales where E grows 4x vs N's 2x, so an O(E) term
+  cannot hide in constants;
+- **host-round-trip** — infeed/outfeed/send/recv or a host-callback
+  custom-call in the compiled module;
+- **alias-dropped** — a declared donated argument missing from the
+  compiled module's ``input_output_alias`` table (donation must
+  survive lowering, not just appear in the jaxpr);
+- **psum-lowering-mismatch** — jaxpr-level psum count != lowered
+  all-reduce count (either direction is a surprise: DCE'd collectives
+  mean the jaxpr lies about the wire, extra all-reduces mean the
+  partitioner invented traffic).
+
+Registry housekeeping mirrors pass 1: a registered jax backend without
+a COMM_INVARIANTS entry is an error (``undeclared-comm-budget``), a
+budget without a lowering recipe is an error (``no-comm-recipe``), and
+a budget for an unregistered name is a warning (``stale-comm-budget``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..budget import COLLECTIVE_KINDS, COMM_INVARIANTS, NON_JAX_BACKENDS, CommBudget
+from ..report import Finding
+from .hlo_walk import parse_module
+from .lowering import COMM_BUILDERS, CommCase, build_cases
+from .waivers import COMM_WAIVERS
+
+
+def _finding(rule: str, message: str, backend: str | None = None,
+             file: str | None = None, line: int | None = None,
+             severity: str = "error") -> Finding:
+    return Finding(
+        pass_name="comm", rule=rule, severity=severity, message=message,
+        backend=backend, file=file, line=line,
+    )
+
+
+def check_comm_case(budget: CommBudget, case: CommCase) -> tuple[list[Finding], dict]:
+    """Evaluate one backend-at-one-scale module against its budget.
+
+    Returns ``(findings, scale record)`` — the record feeds the
+    per-backend ``comm`` section of ANALYSIS.json.
+    """
+    findings: list[Finding] = []
+    mod = parse_module(case.module_text)
+    dims = case.dims
+    scale = f"N={dims.get('n')}/E={dims.get('edges')}"
+
+    # Collective kinds and counts.
+    counts = mod.kind_counts()
+    for kind, count in sorted(counts.items()):
+        site = next(op for op in mod.collectives if op.kind == kind)
+        if kind not in COLLECTIVE_KINDS:
+            # -start/-done splits are normalized; anything else here is
+            # a walker gap, surface it loudly rather than miscount.
+            findings.append(_finding(
+                "collective-kind",
+                f"unrecognized collective {kind!r} in the lowering",
+                case.backend, site.file, site.line,
+            ))
+            continue
+        allowed = budget.allowed_count(kind)
+        if allowed == 0:
+            findings.append(_finding(
+                "collective-kind",
+                f"lowering contains {count} {kind} op(s) at {scale} but the "
+                f"comm budget allows none — the partitioner introduced "
+                f"communication the jaxpr never asked for",
+                case.backend, site.file, site.line,
+            ))
+        elif count > allowed:
+            findings.append(_finding(
+                "collective-count",
+                f"{count} {kind} op(s) at {scale} exceed the declared "
+                f"budget of {allowed}",
+                case.backend, site.file, site.line,
+            ))
+
+    # Byte budget, per-iteration ops only (one-time resharding outside
+    # the while loop is judged by kind/count above).
+    measured = mod.total_bytes(per_iteration_only=True)
+    allowed_bytes = budget.max_bytes(
+        dims.get("n", 0), dims.get("n_segments", 0), dims.get("n_shards", 1)
+    )
+    if measured > allowed_bytes:
+        per_iter = [op for op in mod.collectives if op.per_iteration]
+        site = per_iter[-1] if per_iter else None
+        findings.append(_finding(
+            "comm-bytes-budget",
+            f"per-iteration collective volume {measured} B at {scale} "
+            f"exceeds the O(boundary + N) budget of {allowed_bytes:.0f} B "
+            f"(bytes_n={budget.bytes_n}, bytes_segments="
+            f"{budget.bytes_segments}, bytes_shards={budget.bytes_shards}, "
+            f"bytes_const={budget.bytes_const})",
+            case.backend,
+            site.file if site else None,
+            site.line if site else None,
+        ))
+
+    # Host round-trips.
+    if len(mod.host_calls) > budget.max_host_round_trips:
+        site = mod.host_calls[-1]
+        findings.append(_finding(
+            "host-round-trip",
+            f"{len(mod.host_calls)} host round-trip(s) in the compiled "
+            f"module (budget {budget.max_host_round_trips}): "
+            + ", ".join(h.target or h.op for h in mod.host_calls),
+            case.backend, site.file, site.line,
+        ))
+
+    # Donation must survive into the executable's alias table.
+    aliased = mod.aliased_params()
+    for name in budget.donated_args:
+        if name not in case.arg_names:
+            findings.append(_finding(
+                "alias-dropped",
+                f"budget donates {name!r} but the lowering recipe reports "
+                f"no such argument (recipe/budget drift)",
+                case.backend,
+            ))
+            continue
+        param = case.arg_names.index(name)
+        if param not in aliased:
+            findings.append(_finding(
+                "alias-dropped",
+                f"donated argument {name!r} (parameter {param}) is absent "
+                f"from input_output_alias={sorted(mod.aliases.items())} — "
+                f"the donation died between the jaxpr and the executable",
+                case.backend,
+            ))
+
+    # jaxpr psum count vs lowered all-reduce count.
+    lowered_ar = counts.get("all-reduce", 0)
+    if lowered_ar != case.jaxpr_psums:
+        ars = [op for op in mod.collectives if op.kind == "all-reduce"]
+        site = ars[-1] if ars else None
+        findings.append(_finding(
+            "psum-lowering-mismatch",
+            f"jaxpr has {case.jaxpr_psums} psum(s) but the compiled module "
+            f"has {lowered_ar} all-reduce(s) at {scale} — the partitioner "
+            f"changed the collective structure",
+            case.backend,
+            site.file if site else None,
+            site.line if site else None,
+        ))
+
+    record = {
+        "scale": scale,
+        "dims": dims,
+        "collectives": [op.to_dict() for op in mod.collectives],
+        "bytes_per_iter": measured,
+        "budget_bytes": allowed_bytes,
+        "host_round_trips": [h.to_dict() for h in mod.host_calls],
+        "input_output_alias": {str(k): v for k, v in sorted(mod.aliases.items())},
+        "jaxpr_psums": case.jaxpr_psums,
+        "lowered_all_reduces": lowered_ar,
+        "violations": len(findings),
+    }
+    return findings, record
+
+
+def _apply_waivers(findings: list[Finding]) -> tuple[list[Finding], list[dict], list[dict]]:
+    """Split findings into (live, waived records, stale records) using
+    the enumerated COMM_WAIVERS table — pass-7 doctrine."""
+    live: list[Finding] = []
+    waived: list[dict] = []
+    matched: set[int] = set()
+    for f in findings:
+        hit = next(
+            (
+                (i, w)
+                for i, w in enumerate(COMM_WAIVERS)
+                if w.matches(f.rule, f.file or "", f.message)
+            ),
+            None,
+        )
+        if hit is None:
+            live.append(f)
+        else:
+            matched.add(hit[0])
+            waived.append({
+                "rule": f.rule, "file": f.file, "line": f.line,
+                "symbol": hit[1].symbol, "reason": hit[1].reason,
+            })
+    stale = [
+        {"symbol": w.symbol, "rule": w.rule, "reason": w.reason}
+        for i, w in enumerate(COMM_WAIVERS)
+        if i not in matched
+    ]
+    return live, waived, stale
+
+
+def run_comm_pass(
+    backends: list[str] | None = None,
+) -> tuple[list[Finding], dict[str, Any]]:
+    """Compile and check every registered backend (or the subset).
+
+    Returns ``(findings, comm section)`` for ANALYSIS.json.
+    """
+    # Importing the registry imports the kernel modules, which declare
+    # their comm budgets next to their kernel budgets.
+    from ...parallel import sharded  # noqa: F401  (declares sharded budgets)
+    from ...trust.backend import registered_backends
+
+    registry = registered_backends()
+    targets = registry if backends is None else backends
+    findings: list[Finding] = []
+    section: dict[str, Any] = {"backends": {}}
+
+    for name in targets:
+        if name in NON_JAX_BACKENDS:
+            section["backends"][name] = {
+                "status": "skipped", "reason": "non-jax backend",
+            }
+            continue
+        budget = COMM_INVARIANTS.get(name)
+        if budget is None:
+            section["backends"][name] = {"status": "undeclared"}
+            findings.append(_finding(
+                "undeclared-comm-budget",
+                f"registered backend {name!r} declares no comm budget; add "
+                "a COMM_INVARIANTS declaration next to its kernel (the "
+                "same policy as kernel budgets, PERF.md §15)",
+                name,
+            ))
+            continue
+        if name not in COMM_BUILDERS:
+            section["backends"][name] = {"status": "no-recipe"}
+            findings.append(_finding(
+                "no-comm-recipe",
+                f"comm budget declared for {name!r} but the analyzer has "
+                "no lowering recipe; coverage would be vacuous",
+                name,
+            ))
+            continue
+        try:
+            cases = build_cases(name)
+        except Exception as exc:  # noqa: BLE001 - report, don't crash the gate
+            section["backends"][name] = {
+                "status": "lowering-failed", "error": repr(exc),
+            }
+            findings.append(_finding(
+                "comm-lowering-failure",
+                f"compiling the step failed: {exc!r}",
+                name,
+            ))
+            continue
+        records = []
+        n_violations = 0
+        for case in cases:
+            case_findings, record = check_comm_case(budget, case)
+            findings.extend(case_findings)
+            n_violations += len(case_findings)
+            records.append(record)
+        section["backends"][name] = {
+            "status": "checked",
+            "scales": records,
+            "violations": n_violations,
+            "budget": {
+                "collectives": [
+                    {"kind": cb.kind, "max_count": cb.max_count}
+                    for cb in budget.collectives
+                ],
+                "bytes_n": budget.bytes_n,
+                "bytes_segments": budget.bytes_segments,
+                "bytes_shards": budget.bytes_shards,
+                "bytes_const": budget.bytes_const,
+                "max_host_round_trips": budget.max_host_round_trips,
+                "donated_args": list(budget.donated_args),
+                "notes": budget.notes,
+            },
+        }
+
+    # Budgets for names no longer in the registry rot silently.
+    if backends is None:
+        for name in sorted(set(COMM_INVARIANTS) - set(registry)):
+            findings.append(_finding(
+                "stale-comm-budget",
+                f"comm budget declared for {name!r} which is not a "
+                "registered backend",
+                name, severity="warning",
+            ))
+
+    live, waived, stale = _apply_waivers(findings)
+    for entry in stale:
+        # A dead waiver is itself a gate failure — pass-7 doctrine,
+        # enforced in the default full run for every waiver table.
+        live.append(_finding(
+            "stale-waiver",
+            f"comm waiver {entry['symbol']!r} ({entry['rule']}) matches no "
+            "live finding; a fixed lowering must take its waiver with it",
+            None,
+        ))
+    section["waived"] = waived
+    section["stale_waivers"] = stale
+    return live, section
+
+
+__all__ = ["check_comm_case", "run_comm_pass"]

@@ -1,0 +1,296 @@
+"""Per-backend lowering recipes for pass 8.
+
+Each recipe builds the backend's real converge entry point on a
+synthetic graph, lowers it through the real jit path, **compiles** it
+under the 8-device CPU mesh (the SPMD partitioner only runs at
+compile), and returns the module text plus the context needed to judge
+it: the problem dims the byte budget is a function of, the entry-point
+argument names (so ``donated_args`` resolve to parameter numbers in
+the ``input_output_alias`` table), and the jaxpr-level psum count for
+the lowering cross-check.
+
+Scales: the sharded composites — the only backends whose lowering can
+legally contain collectives — are compiled at **two** scales where the
+edge count grows 4x but N only 2x, so a byte volume that follows E
+breaks the (linear in N/S) budget at the second scale no matter how
+the constants were padded.  Single-device backends compile once: their
+budget is zero collectives at any scale, so a second compile proves
+nothing and the (Pallas-interpret) windowed compile is the analyzer's
+dominant cost.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..jaxpr_walk import PSUM_PRIMITIVES, collect_primitives
+
+#: (n_peers, n_edges) per scale: E x4, N x2 between the two.
+COMM_SCALES: tuple[tuple[int, int], ...] = ((1024, 4096), (2048, 16384))
+
+#: Shard count of the analysis mesh (tests/conftest.py doctrine).
+N_SHARDS = 8
+
+
+@dataclass
+class CommCase:
+    """One backend at one scale: the compiled module plus its context."""
+
+    backend: str
+    #: Budget dimensions: n, edges, n_shards, and n_segments where the
+    #: backend has a segment table.
+    dims: dict[str, int]
+    #: ``compiled.as_text()`` of the converge entry point.
+    module_text: str
+    #: Entry-point argument names, parameter order (donation mapping).
+    arg_names: tuple[str, ...]
+    #: psum/psum2 count in the traced jaxpr of the same entry point.
+    jaxpr_psums: int = 0
+    #: Free-form per-scale metadata for ANALYSIS.json.
+    meta: dict[str, Any] = field(default_factory=dict)
+
+
+def _graph(n: int, e: int):
+    import numpy as np
+
+    from ...models.graphs import scale_free
+    from ...trust.graph import TrustGraph
+
+    g = scale_free(n, e, seed=2)
+    keep = ~np.isin(g.src, np.asarray([0, 17, n - 1], dtype=np.int32))
+    return TrustGraph(g.n, g.src[keep], g.dst[keep], g.weight[keep], g.pre_trusted)
+
+
+def _normalized(graph):
+    import numpy as np
+
+    from ...trust.graph import TrustGraph
+
+    g = graph.drop_self_edges()
+    w, dangling = g.row_normalized()
+    gs = TrustGraph(g.n, g.src, g.dst, w, g.pre_trusted).sorted_by_dst()
+    return g, gs, w, dangling.astype(np.float32)
+
+
+def _jaxpr_psums(jaxpr: Any) -> int:
+    return len(collect_primitives(jaxpr, PSUM_PRIMITIVES))
+
+
+def _lower_dense(n: int, e: int) -> CommCase:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...ops.dense import converge_dense
+
+    rng = np.random.default_rng(0)
+    size = 64
+    m = rng.random((size, size)).astype(np.float32)
+    m /= m.sum(axis=0, keepdims=True)
+    t = jnp.asarray(np.full(size, 1.0 / size, np.float32))
+    m = jnp.asarray(m)
+    lowered = converge_dense.lower(m, t, 4)
+    jaxpr = jax.make_jaxpr(lambda mm, tt: converge_dense(mm, tt, 4))(m, t)
+    return CommCase(
+        backend="tpu-dense",
+        dims={"n": size, "edges": size * size, "n_shards": 1},
+        module_text=lowered.compile().as_text(),
+        arg_names=("ops_t", "s0"),
+        jaxpr_psums=_jaxpr_psums(jaxpr),
+    )
+
+
+def _lower_sparse(n: int, e: int) -> CommCase:
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.sparse import converge_sparse
+
+    g, gs, w, dangling = _normalized(_graph(n, e))
+    p = g.pre_trust_vector()
+    args = (
+        jnp.asarray(gs.src),
+        jnp.asarray(gs.dst),
+        jnp.asarray(gs.weight),
+        jnp.asarray(p),
+        jnp.asarray(p),
+        jnp.asarray(dangling),
+    )
+    kw = dict(n=g.n, alpha=jnp.asarray(0.1, jnp.float32), tol=1e-6, max_iter=4)
+    lowered = converge_sparse.lower(*args, **kw)
+    jaxpr = jax.make_jaxpr(
+        lambda *a: converge_sparse(*a, **kw), static_argnums=()
+    )(*args)
+    return CommCase(
+        backend="tpu-sparse",
+        dims={"n": g.n, "edges": g.nnz, "n_shards": 1},
+        module_text=lowered.compile().as_text(),
+        arg_names=("src", "dst", "w", "t0", "p", "dangling"),
+        jaxpr_psums=_jaxpr_psums(jaxpr),
+    )
+
+
+def _lower_csr(n: int, e: int) -> CommCase:
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.sparse import converge_csr
+
+    g, gs, w, dangling = _normalized(_graph(n, e))
+    p = g.pre_trust_vector()
+    args = (
+        jnp.asarray(gs.src),
+        jnp.asarray(gs.row_ptr_by_dst()),
+        jnp.asarray(gs.weight),
+        jnp.asarray(p),
+        jnp.asarray(p),
+        jnp.asarray(dangling),
+    )
+    kw = dict(alpha=jnp.asarray(0.1, jnp.float32), tol=1e-6, max_iter=4)
+    lowered = converge_csr.lower(*args, **kw)
+    jaxpr = jax.make_jaxpr(lambda *a: converge_csr(*a, **kw))(*args)
+    return CommCase(
+        backend="tpu-csr",
+        dims={"n": g.n, "edges": g.nnz, "n_shards": 1},
+        module_text=lowered.compile().as_text(),
+        arg_names=("src", "row_ptr", "w", "t0", "p", "dangling"),
+        jaxpr_psums=_jaxpr_psums(jaxpr),
+    )
+
+
+def _lower_windowed(n: int, e: int) -> CommCase:
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.gather_window import build_window_plan, converge_windowed
+
+    g, gs, w, dangling = _normalized(_graph(n, e))
+    plan = build_window_plan(g.src, g.dst, w, n=g.n)
+    p = g.pre_trust_vector()
+    args = plan.device_args() + (
+        jnp.asarray(p),
+        jnp.asarray(p),
+        jnp.asarray(dangling),
+    )
+    kw = dict(
+        n_rows=plan.n_rows,
+        table_entries=plan.table_entries,
+        alpha=jnp.asarray(0.1, jnp.float32),
+        tol=1e-6,
+        max_iter=4,
+        interpret=True,
+    )
+    lowered = converge_windowed.lower(*args, **kw)
+    jaxpr = jax.make_jaxpr(lambda *a: converge_windowed(*a, **kw))(*args)
+    return CommCase(
+        backend="tpu-windowed",
+        dims={
+            "n": g.n,
+            "edges": g.nnz,
+            "n_segments": plan.seg_capacity,
+            "n_shards": 1,
+        },
+        module_text=lowered.compile().as_text(),
+        arg_names=(
+            "wid", "local", "weight", "seg_end", "seg_first", "seg_perm",
+            "dst_ptr", "t0", "p", "dangling",
+        ),
+        jaxpr_psums=_jaxpr_psums(jaxpr),
+    )
+
+
+def _lower_sharded_csr(n: int, e: int) -> CommCase:
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from ...parallel.mesh import SHARD_AXIS, default_mesh
+    from ...parallel.sharded import ShardedTrustProblem, _get_runner
+
+    mesh = default_mesh()
+    prob = ShardedTrustProblem.build(_graph(n, e), mesh)
+    run = _get_runner(mesh, prob.n)
+    args = (
+        prob.src, prob.w, prob.row_ptr, prob.t0(), prob.p, prob.dangling,
+        jnp.asarray(0.1, jnp.float32),
+    )
+    kw = dict(max_iter=4, tol=1e-6)
+    lowered = run.lower(*args, **kw)
+    jaxpr = jax.make_jaxpr(partial(run, **kw))(*args)
+    return CommCase(
+        backend="tpu-sharded:tpu-csr",
+        dims={
+            "n": prob.n,
+            "edges": int(prob.src.shape[0]),
+            "n_shards": mesh.shape[SHARD_AXIS],
+        },
+        module_text=lowered.compile().as_text(),
+        arg_names=("src", "w", "row_ptr", "t0", "p", "dangling", "alpha"),
+        jaxpr_psums=_jaxpr_psums(jaxpr),
+    )
+
+
+def _lower_sharded_windowed(n: int, e: int) -> CommCase:
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from ...parallel.mesh import SHARD_AXIS, default_mesh
+    from ...parallel.sharded import ShardedWindowPlan, _get_windowed_runner
+
+    mesh = default_mesh()
+    graph = _graph(n, e)
+    swp = ShardedWindowPlan.build(graph, mesh)
+    run = _get_windowed_runner(
+        mesh, swp.n, swp.rows_per_shard, swp.table_entries, swp.interpret
+    )
+    args = (
+        swp.wid, swp.local, swp.weight, swp.seg_end, swp.seg_first,
+        swp.seg_perm, swp.dst_ptr, swp.t0(), swp.p, swp.dangling,
+        jnp.asarray(0.1, jnp.float32),
+    )
+    kw = dict(max_iter=4, tol=1e-6)
+    lowered = run.lower(*args, **kw)
+    jaxpr = jax.make_jaxpr(partial(run, **kw))(*args)
+    return CommCase(
+        backend="tpu-sharded:tpu-windowed",
+        dims={
+            "n": swp.n,
+            "edges": int(graph.drop_self_edges().nnz),
+            "n_segments": swp.s_max,
+            "n_shards": mesh.shape[SHARD_AXIS],
+        },
+        module_text=lowered.compile().as_text(),
+        arg_names=(
+            "wid", "local", "weight", "seg_end", "seg_first", "seg_perm",
+            "dst_ptr", "t0", "p", "dangling", "alpha",
+        ),
+        jaxpr_psums=_jaxpr_psums(jaxpr),
+    )
+
+
+#: backend -> (recipe, compiled at both COMM_SCALES?).  Only the
+#: sharded composites pay for the second scale — they are the backends
+#: whose lowering may legally communicate.
+COMM_BUILDERS: dict[str, tuple[Callable[[int, int], CommCase], bool]] = {
+    "tpu-dense": (_lower_dense, False),
+    "tpu-sparse": (_lower_sparse, False),
+    "tpu-csr": (_lower_csr, False),
+    "tpu-windowed": (_lower_windowed, False),
+    "tpu-sharded:tpu-csr": (_lower_sharded_csr, True),
+    "tpu-sharded:tpu-windowed": (_lower_sharded_windowed, True),
+}
+
+
+def build_cases(backend: str) -> list[CommCase]:
+    """Compile ``backend`` at its scale set and return one case per
+    scale.  Raises KeyError for a backend without a recipe."""
+    recipe, two_scale = COMM_BUILDERS[backend]
+    scales = COMM_SCALES if two_scale else COMM_SCALES[:1]
+    return [recipe(n, e) for n, e in scales]
+
+
+__all__ = ["COMM_BUILDERS", "COMM_SCALES", "CommCase", "N_SHARDS", "build_cases"]

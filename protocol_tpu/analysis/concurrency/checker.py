@@ -708,6 +708,26 @@ def analyze_models(
                 }
             )
 
+    stale_waivers = [
+        {"symbol": w.symbol, "rule": w.rule, "reason": w.reason}
+        for i, w in enumerate(waivers)
+        if i not in matched
+    ]
+    # A dead waiver is itself a gate failure, in every run that
+    # evaluates the table (the default full run included) — a fixed bug
+    # must take its waiver with it, or the entry silently pre-suppresses
+    # the NEXT bug in the same file.
+    for entry in stale_waivers:
+        live_findings.append(
+            _finding(
+                "stale-waiver",
+                f"waiver {entry['symbol']!r} ({entry['rule']}) matches no "
+                "live finding; remove it with the fix it documented",
+                "protocol_tpu/analysis/concurrency/waivers.py",
+                None,
+            )
+        )
+
     section = {
         "roots": [r.to_dict() for r in roots],
         "confined_trees": list(_CONFINED_TREES),
@@ -723,11 +743,7 @@ def analyze_models(
         },
         "findings": len(live_findings),
         "waived": waived,
-        "stale_waivers": [
-            {"symbol": w.symbol, "rule": w.rule, "reason": w.reason}
-            for i, w in enumerate(waivers)
-            if i not in matched
-        ],
+        "stale_waivers": stale_waivers,
     }
     return live_findings, section, static
 

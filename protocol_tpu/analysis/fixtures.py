@@ -418,6 +418,197 @@ def _native_call_under_lock() -> tuple[str, str]:
     return _NATIVE_UNDER_LOCK_SRC, "protocol_tpu/node/_fixture_native_lock.py"
 
 
+#: Pass-8 seeded violations (SPMD-lowering comm rules).  Each fixture
+#: compiles a REAL module through the real jit/partitioner path under
+#: the 8-device CPU mesh and pairs it with a CommBudget it violates;
+#: the finding anchors through jax's HLO source metadata back to the
+#: ``# VIOLATION:`` line below — the same file:line contract as the
+#: jaxpr fixtures.
+
+
+def _comm_mesh():
+    from ..parallel.mesh import SHARD_AXIS, default_mesh
+
+    return default_mesh(), SHARD_AXIS
+
+
+def _comm_case(backend, fn, args, dims, arg_names=()):
+    """Compile ``fn`` and wrap it as a CommCase (jaxpr psums counted
+    from the same trace the module was lowered from)."""
+    import jax
+
+    from .comm.lowering import CommCase
+    from .jaxpr_walk import PSUM_PRIMITIVES, collect_primitives
+
+    lowered = jax.jit(fn).lower(*args)
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    return CommCase(
+        backend=backend,
+        dims=dims,
+        module_text=lowered.compile().as_text(),
+        arg_names=tuple(arg_names),
+        jaxpr_psums=len(collect_primitives(jaxpr, PSUM_PRIMITIVES)),
+    )
+
+
+def _surprise_all_gather():
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharded import _shard_map
+    from .budget import CollectiveBudget, CommBudget
+
+    mesh, axis = _comm_mesh()
+    n_shards = mesh.shape[axis]
+    v = jax.device_put(
+        np.ones(64 * n_shards, np.float32), NamedSharding(mesh, P(axis))
+    )
+
+    @partial(_shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def step(local):
+        # The partitioner-surprise anti-pattern: re-materializing the
+        # full edge slice on every shard before reducing.
+        full = lax.all_gather(local, "shard", tiled=True)  # VIOLATION: surprise-all-gather
+        return lax.psum(jnp.sum(full), "shard")
+
+    budget = CommBudget(
+        backend="fixture:surprise-all-gather",
+        collectives=(CollectiveBudget(kind="all-reduce", max_count=1),),
+        bytes_const=1 << 20,
+    )
+    case = _comm_case(
+        "fixture:surprise-all-gather", step, (v,),
+        dims={"n": 64, "n_shards": n_shards},
+    )
+    return budget, [case]
+
+
+def _comm_bytes_over_budget():
+    from functools import partial
+
+    import jax
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharded import _shard_map
+    from .budget import CollectiveBudget, CommBudget
+
+    mesh, axis = _comm_mesh()
+    n_shards = mesh.shape[axis]
+    n, e = 64, 4096
+    v = jax.device_put(np.ones(e, np.float32), NamedSharding(mesh, P()))
+
+    @partial(_shard_map, mesh=mesh, in_specs=P(), out_specs=P())
+    def step(acc):
+        # An O(E) psum inside the iteration loop: every step ships the
+        # whole edge-sized vector over the wire instead of the N-sized
+        # boundary completion.
+        return lax.psum(acc, "shard") / n_shards  # VIOLATION: comm-bytes-over-budget
+
+    def run(v):
+        return lax.fori_loop(0, 4, lambda i, acc: step(acc), v)
+
+    budget = CommBudget(
+        backend="fixture:comm-bytes-over-budget",
+        collectives=(CollectiveBudget(kind="all-reduce", max_count=1),),
+        bytes_n=8.0,  # O(N) allowance only — E-sized traffic must trip
+    )
+    case = _comm_case(
+        "fixture:comm-bytes-over-budget", run, (v,),
+        dims={"n": n, "edges": e, "n_shards": n_shards},
+    )
+    return budget, [case]
+
+
+def _host_round_trip():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .budget import CommBudget
+
+    def host_sum(x):
+        return np.float32(np.asarray(x).sum())
+
+    def step(t):
+        out = jax.ShapeDtypeStruct((), jnp.float32)
+        s = jax.pure_callback(host_sum, out, t)  # VIOLATION: host-round-trip
+        return t * s
+
+    budget = CommBudget(
+        backend="fixture:host-round-trip", max_host_round_trips=0
+    )
+    case = _comm_case(
+        "fixture:host-round-trip", step, (jnp.ones(8, jnp.float32),),
+        dims={"n": 8, "n_shards": 1},
+    )
+    return budget, [case]
+
+
+def _alias_dropped():
+    import jax.numpy as jnp
+
+    from .budget import CommBudget
+
+    def undonated(t0):  # no donate_argnames — the alias never lowers
+        return t0 * 2.0
+
+    budget = CommBudget(
+        backend="fixture:alias-dropped", donated_args=("t0",)
+    )
+    case = _comm_case(
+        "fixture:alias-dropped", undonated, (jnp.ones(4, jnp.float32),),
+        dims={"n": 4, "n_shards": 1}, arg_names=("t0",),
+    )
+    return budget, [case]
+
+
+def _psum_lowering_mismatch():
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharded import _shard_map
+    from .budget import CollectiveBudget, CommBudget
+
+    mesh, axis = _comm_mesh()
+    v = jax.device_put(
+        np.arange(16, dtype=np.float32), NamedSharding(mesh, P(axis))
+    )
+
+    @partial(_shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def step(local):
+        # The dead psum survives the jaxpr but DCE strips it from the
+        # compiled module: the jaxpr now LIES about the wire — exactly
+        # the jaxpr-vs-lowering drift the cross-check exists to catch.
+        dead = lax.psum(jnp.sum(local * 2.0), "shard")  # noqa: F841
+        return lax.psum(jnp.sum(local), "shard")  # VIOLATION: psum-lowering-mismatch
+
+    budget = CommBudget(
+        backend="fixture:psum-lowering-mismatch",
+        collectives=(CollectiveBudget(kind="all-reduce", max_count=2),),
+        bytes_const=1 << 20,
+    )
+    case = _comm_case(
+        "fixture:psum-lowering-mismatch", step, (v,),
+        dims={"n": 16, "n_shards": mesh.shape[axis]},
+    )
+    return budget, [case]
+
+
 FIXTURES: dict[str, Fixture] = {
     f.name: f
     for f in (
@@ -492,6 +683,26 @@ FIXTURES: dict[str, Fixture] = {
             _native_call_under_lock, "native-call-under-lock",
             kind="concurrency",
         ),
+        Fixture(
+            "surprise-all-gather", "collective-kind",
+            _surprise_all_gather, "surprise-all-gather", kind="comm",
+        ),
+        Fixture(
+            "comm-bytes-over-budget", "comm-bytes-budget",
+            _comm_bytes_over_budget, "comm-bytes-over-budget", kind="comm",
+        ),
+        Fixture(
+            "host-round-trip", "host-round-trip",
+            _host_round_trip, "host-round-trip", kind="comm",
+        ),
+        Fixture(
+            "alias-dropped", "alias-dropped", _alias_dropped, None,
+            kind="comm",
+        ),
+        Fixture(
+            "psum-lowering-mismatch", "psum-lowering-mismatch",
+            _psum_lowering_mismatch, "psum-lowering-mismatch", kind="comm",
+        ),
     )
 }
 
@@ -510,6 +721,11 @@ def run_fixture(name: str) -> list[Finding]:
 
         source, rel_path = fixture.build()
         return analyze_sources({rel_path: source})
+    if fixture.kind == "comm":
+        from .comm.checker import check_comm_case
+
+        budget, cases = fixture.build()
+        return [f for c in cases for f in check_comm_case(budget, c)[0]]
     budget, case = fixture.build()
     return check_case(budget, case)
 

@@ -216,7 +216,7 @@ def _trace_sharded_csr(graph) -> TraceCase:
     mesh = default_mesh()
     prob = ShardedTrustProblem.build(graph, mesh)
     run = _get_runner(mesh, prob.n)
-    jaxpr = jax.make_jaxpr(partial(run, max_iter=4, tol=1e-6))(
+    args = (
         prob.src,
         prob.w,
         prob.row_ptr,
@@ -225,9 +225,14 @@ def _trace_sharded_csr(graph) -> TraceCase:
         prob.dangling,
         jnp.asarray(0.1, jnp.float32),
     )
+    jaxpr = jax.make_jaxpr(partial(run, max_iter=4, tol=1e-6))(*args)
+    lowered = run.lower(*args, max_iter=4, tol=1e-6).as_text()
     shard_edges = prob.src.shape[0] // mesh.shape[SHARD_AXIS]
     return TraceCase(
-        "tpu-sharded:tpu-csr", jaxpr, dims={"edges": shard_edges, "n": prob.n}
+        "tpu-sharded:tpu-csr",
+        jaxpr,
+        dims={"edges": shard_edges, "n": prob.n},
+        lowered_text=lowered,
     )
 
 
@@ -246,7 +251,7 @@ def _trace_sharded_windowed(graph) -> TraceCase:
     run = _get_windowed_runner(
         mesh, swp.n, swp.rows_per_shard, swp.table_entries, swp.interpret
     )
-    jaxpr = jax.make_jaxpr(partial(run, max_iter=4, tol=1e-6))(
+    args = (
         swp.wid,
         swp.local,
         swp.weight,
@@ -259,10 +264,13 @@ def _trace_sharded_windowed(graph) -> TraceCase:
         swp.dangling,
         jnp.asarray(0.1, jnp.float32),
     )
+    jaxpr = jax.make_jaxpr(partial(run, max_iter=4, tol=1e-6))(*args)
+    lowered = run.lower(*args, max_iter=4, tol=1e-6).as_text()
     return TraceCase(
         "tpu-sharded:tpu-windowed",
         jaxpr,
         dims={"n_segments": swp.s_max, "n": swp.n},
+        lowered_text=lowered,
     )
 
 

@@ -68,6 +68,10 @@ class Report:
     #: inferred guard map, the lock-order graph, and the enumerated
     #: waiver list (analysis/concurrency/).
     concurrency: dict[str, Any] = field(default_factory=dict)
+    #: Pass-8 SPMD-lowering section: per-backend collective tables with
+    #: byte volumes at each compiled scale, host round-trips, the
+    #: input_output_alias map, and the comm waiver list (analysis/comm/).
+    comm: dict[str, Any] = field(default_factory=dict)
 
     def extend(self, findings: list[Finding]) -> None:
         self.findings.extend(findings)
@@ -94,6 +98,7 @@ class Report:
             },
             "backends": self.backends,
             "concurrency": self.concurrency,
+            "comm": self.comm,
             "findings": [f.to_dict() for f in self.findings],
         }
 

@@ -21,7 +21,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
-from ..analysis.budget import KERNEL_INVARIANTS, NON_JAX_BACKENDS
+from ..analysis.budget import COMM_INVARIANTS, KERNEL_INVARIANTS, NON_JAX_BACKENDS
 from ..crypto import calculate_message_hash, group_pks_hash, message_hash_batch
 from ..crypto.eddsa import PublicKey, sign, verify as verify_sig
 from ..obs import TRACER
@@ -163,6 +163,30 @@ class Manager:
         #: and the window-plan cache handoff.  Pinned by graftlint
         #: pass 7 (analysis/concurrency/).
         self._state_lock = threading.Lock()
+        # Comm-budget pin check at config time (the kernel-budget
+        # analog runs per-converge below): a sharded backend without a
+        # COMM_INVARIANTS entry runs with its collective structure and
+        # wire volume unpinned — graftlint pass 8 cannot gate what was
+        # never declared, and at pod scale an unbudgeted all-gather is
+        # the wall ROADMAP item 3 exists to avoid.
+        comm_key = (
+            "tpu-sharded:tpu-csr"
+            if self.config.backend == "tpu-sharded"
+            else self.config.backend
+        )
+        if comm_key.startswith("tpu-sharded"):
+            # The sharded budgets are declared at parallel/sharded.py
+            # import time; load it so the check reads the real table,
+            # not an import-order accident (the backend itself imports
+            # the same module on first converge anyway).
+            from ..parallel import sharded as _sharded  # noqa: F401
+        if comm_key.startswith("tpu-sharded") and comm_key not in COMM_INVARIANTS:
+            logger.warning(
+                "sharded trust backend %r has no COMM_INVARIANTS "
+                "declaration; its collective structure is not lint-gated "
+                "(PERF.md §15)",
+                self.config.backend,
+            )
         #: Senders whose attestation changed since the window plan last
         #: advanced — the delta-plan churn source.  Accumulates across
         #: failed epochs; cleared per successful converge.

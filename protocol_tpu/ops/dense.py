@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..analysis.budget import KernelBudget, declare
+from ..analysis.budget import CommBudget, KernelBudget, declare, declare_comm
 
 
 @partial(jax.jit, static_argnames=("num_iter",))
@@ -115,5 +115,17 @@ declare(
         max_scatters=0,
         require_primitives=("dot_general",),
         notes="matmul-only power step under lax.scan",
+    )
+)
+
+#: Single-device matmul chunk: the compiled module must contain zero
+#: collectives and zero host round-trips (graftlint pass 8; the
+#: host-side tol check between scan chunks lives OUTSIDE the jit).
+#: ``converge_dense`` takes no donated seed — the chunked driver
+#: re-feeds ``t`` itself.
+declare_comm(
+    CommBudget(
+        backend="tpu-dense",
+        notes="single-device scan chunk: no wire, no host traffic",
     )
 )

@@ -53,7 +53,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from ..analysis.budget import GatherBudget, KernelBudget, declare
+from ..analysis.budget import (
+    CommBudget,
+    GatherBudget,
+    KernelBudget,
+    declare,
+    declare_comm,
+)
 from .sparse import _ds_cumsum_axis1, rowsum_sorted, run_power_iteration
 
 try:
@@ -1159,5 +1165,17 @@ declare(
             "fused pipeline: 1 random n_segments pass (dst perm), "
             "streaming 2-wide boundary read, 4 rowsum pointer reads"
         ),
+    )
+)
+
+#: Single-device fused pipeline (graftlint pass 8): zero collectives,
+#: zero host round-trips — the Pallas windowed gather is VMEM-local by
+#: construction — and the t0 donation must survive into the compiled
+#: module's input_output_alias table (PERF.md §15).
+declare_comm(
+    CommBudget(
+        backend="tpu-windowed",
+        donated_args=("t0",),
+        notes="single-device fused pipeline: no wire, no host traffic",
     )
 )

@@ -27,7 +27,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..analysis.budget import GatherBudget, KernelBudget, declare
+from ..analysis.budget import (
+    CommBudget,
+    GatherBudget,
+    KernelBudget,
+    declare,
+    declare_comm,
+)
 
 
 def _compensated_cumsum(x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -323,5 +329,27 @@ declare(
         gather_budgets=(GatherBudget(dim="edges", max_total=1, max_random=1),),
         donated_args=("t0",),
         notes="scatter-free CSR: 1 random E-gather + 4 rowsum pointer reads",
+    )
+)
+
+# -- communication budgets (PERF.md §15, graftlint pass 8) ------------------
+# Single-device steps: zero collectives, zero host round-trips, and the
+# t0 donation of the jit entry must survive into the compiled module's
+# input_output_alias table (a dropped alias doubles peak HBM at 1M
+# peers and ships silently — the jaxpr cannot see it).
+
+declare_comm(
+    CommBudget(
+        backend="tpu-sparse",
+        donated_args=("t0",),
+        notes="single-device segment-sum loop: no wire, no host traffic",
+    )
+)
+
+declare_comm(
+    CommBudget(
+        backend="tpu-csr",
+        donated_args=("t0",),
+        notes="single-device CSR/cumsum loop: no wire, no host traffic",
     )
 )

@@ -57,7 +57,14 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..analysis.budget import GatherBudget, KernelBudget, declare
+from ..analysis.budget import (
+    CollectiveBudget,
+    CommBudget,
+    GatherBudget,
+    KernelBudget,
+    declare,
+    declare_comm,
+)
 from ..ops.gather_window import (
     BLOCK_ROWS,
     PLAN_VERSION,
@@ -129,8 +136,10 @@ class ShardedTrustProblem:
 
     def t0(self) -> jax.Array:
         """Initial score vector: the pre-trust distribution (the scaled
-        analog of everyone starting at INITIAL_SCORE)."""
-        return self.p
+        analog of everyone starting at INITIAL_SCORE).  A fresh device
+        copy, not ``p`` itself: the runners donate ``t0`` (PERF.md §15)
+        and ``p`` must survive the iteration it seeds."""
+        return jnp.copy(self.p)
 
 
 # Compiled runners keyed by (mesh, n) for the CSR kernel and by
@@ -173,13 +182,22 @@ def _get_runner(mesh: Mesh, n: int):
         t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
         return t_new / jnp.sum(t_new)
 
-    @partial(jax.jit, static_argnames=("max_iter", "tol", "record_residuals"))
+    @partial(
+        jax.jit,
+        static_argnames=("max_iter", "tol", "record_residuals"),
+        donate_argnames=("t0",),
+    )
     def run(
         src, w, row_ptr, t0, p, dangling, alpha,
         *, max_iter, tol, record_residuals=False,
     ):
         from ..ops.sparse import run_power_iteration
 
+        # t0 is donated (same contract as converge_csr): the iteration
+        # consumes the seed in place — callers stage a fresh replicated
+        # buffer per converge (problem.t0() copies, converge_sharded
+        # device_puts warm seeds).  Pass 8 pins the aliasing in the
+        # compiled module, not just here.
         return run_power_iteration(
             lambda t: step(src, w, row_ptr, t, p, dangling, alpha),
             t0,
@@ -331,7 +349,9 @@ class ShardedWindowPlan:
         )
 
     def t0(self) -> jax.Array:
-        return self.p
+        """Fresh device copy of the pre-trust vector (the runner
+        donates its seed; see ``ShardedTrustProblem.t0``)."""
+        return jnp.copy(self.p)
 
 
 def _get_windowed_runner(
@@ -388,7 +408,11 @@ def _get_windowed_runner(
         t_new = (1.0 - alpha) * (ct + dangling_mass * p) + alpha * p
         return t_new / jnp.sum(t_new)
 
-    @partial(jax.jit, static_argnames=("max_iter", "tol", "record_residuals"))
+    @partial(
+        jax.jit,
+        static_argnames=("max_iter", "tol", "record_residuals"),
+        donate_argnames=("t0",),
+    )
     def run(
         wid, local, weight, seg_end, seg_first, seg_perm, dst_ptr,
         t0, p, dangling, alpha, *, max_iter, tol, record_residuals=False,
@@ -518,6 +542,7 @@ declare(
         max_scatters=0,
         psum_count=1,
         gather_budgets=(GatherBudget(dim="edges", max_total=1, max_random=1),),
+        donated_args=("t0",),
         notes="per-shard rowsum_sorted + one boundary-completing psum",
     )
 )
@@ -537,6 +562,53 @@ declare(
                 dim="n_segments", max_total=2, max_random=1, boundary_sorted=True
             ),
         ),
+        donated_args=("t0",),
         notes="sharded fused pipeline: per-shard windowed_ct + one psum",
+    )
+)
+
+
+# ---------------------------------------------------------------------------
+# Pinned communication budgets (PERF.md §15) — checked against the
+# COMPILED (SPMD-partitioned) module by graftlint pass 8 at two problem
+# scales, and at runtime by the 2-process tools/comm_probe.py smoke.
+# ---------------------------------------------------------------------------
+
+#: CSR shards: exactly one f32[N] all-reduce per iteration (the
+#: boundary-completing psum — destinations whose edge runs straddle a
+#: shard cut ride the same reduce, so there is no separate boundary
+#: collective).  Byte allowance 8·N = the 4·N wire volume with 2x
+#: slack; NO term may scale with E — the whole point of the recipe is
+#: that 50M edges cross zero wires.  t0's donation must survive into
+#: the executable's input_output_alias table.
+declare_comm(
+    CommBudget(
+        backend="tpu-sharded:tpu-csr",
+        collectives=(CollectiveBudget(kind="all-reduce", max_count=1),),
+        bytes_n=8.0,
+        bytes_const=1024.0,
+        max_host_round_trips=0,
+        donated_args=("t0",),
+        notes="one boundary-completing f32[N] psum per step; comm is "
+        "O(N), never O(E)",
+    )
+)
+
+#: Windowed shards: identical wire shape — the per-shard fused pipeline
+#: reduces its partial Cᵀt into the same single f32[N] all-reduce;
+#: boundary segments are folded per shard before the reduce, so the
+#: segment table contributes no collective bytes (bytes_segments stays
+#: 0 as a declaration that boundary traffic rides the psum).
+declare_comm(
+    CommBudget(
+        backend="tpu-sharded:tpu-windowed",
+        collectives=(CollectiveBudget(kind="all-reduce", max_count=1),),
+        bytes_n=8.0,
+        bytes_segments=0.0,
+        bytes_const=1024.0,
+        max_host_round_trips=0,
+        donated_args=("t0",),
+        notes="sharded fused pipeline: per-shard windowed_ct partials "
+        "completed by one f32[N] psum; comm is O(N), never O(E)",
     )
 )

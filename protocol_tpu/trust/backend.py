@@ -511,9 +511,13 @@ _BACKENDS = {
 def registered_backends() -> list[str]:
     """Every constructible backend name, composites expanded — the
     enumeration the static analyzer hard-gates (each name must carry a
-    ``KERNEL_INVARIANTS`` declaration next to its kernel, or
-    ``python -m protocol_tpu.analysis`` fails the lint wall).  Plain
-    ``tpu-sharded`` is the ``tpu-sharded:tpu-csr`` composite."""
+    ``KERNEL_INVARIANTS`` declaration next to its kernel AND a
+    ``COMM_INVARIANTS`` declaration for graftlint pass 8, or
+    ``python -m protocol_tpu.analysis`` fails the lint wall: an
+    unregistered kernel budget is ``undeclared-backend``, an
+    unregistered comm budget is ``undeclared-comm-budget`` — same
+    policy, same gate).  Plain ``tpu-sharded`` is the
+    ``tpu-sharded:tpu-csr`` composite."""
     from ..parallel.sharded import SHARDED_KERNELS
 
     names: list[str] = []

@@ -16,10 +16,15 @@ python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.
 # AST ruleset over protocol_tpu/; pass 7 is the whole-program
 # concurrency analyzer (thread-root discovery, shared-state guard
 # inference, lock-order cycles, blocking/native-under-lock) with its
-# enumerated waiver table.  Any error-severity finding — including an
-# unwaived concurrency finding — fails here.  Emits ANALYSIS.json
-# (uploaded as a CI artifact; the concurrency section carries the root
-# inventory, guard map, lock graph, and waiver list).
+# enumerated waiver table; pass 8 is the SPMD-lowering comm analyzer
+# (compiles every backend under the 8-device CPU mesh and checks the
+# partitioner's collectives/bytes/aliasing against COMM_INVARIANTS,
+# sharded composites at two problem scales).  Any error-severity
+# finding — including an unwaived concurrency/comm finding or a STALE
+# waiver in either table — fails here.  Emits ANALYSIS.json (uploaded
+# as a CI artifact; the concurrency and comm sections carry the root
+# inventory, guard map, lock graph, per-backend collective/byte
+# tables, and waiver lists).
 python -m protocol_tpu.analysis --output ANALYSIS.json
 
 # Trees held to the hard format/type gates: the convergence-kernel,

@@ -271,11 +271,14 @@ class TestConcurrencyPass:
             assert guarded.get(attr) == [lock], (attr, guarded.get(attr))
 
     def test_analyzer_self_budget(self, real_report):
-        """Full-tree graftlint (all passes, backends traced) stays
-        under 60 s — the gate must remain cheap enough to run hard on
-        every lint."""
+        """Full-tree graftlint (all passes, backends traced AND
+        compiled) stays under 120 s — the gate must remain cheap enough
+        to run hard on every lint.  Pass 8 raised the floor: it
+        XLA-compiles all six backends (the two Pallas-interpret
+        windowed compiles dominate at ~25 s), measured ~45 s total on
+        the 1-core container."""
         _, report = real_report
-        assert report["_wall_s"] < 60.0, report["_wall_s"]
+        assert report["_wall_s"] < 120.0, report["_wall_s"]
 
     # -- precision negatives -------------------------------------------
 

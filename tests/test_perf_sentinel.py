@@ -235,6 +235,17 @@ class TestSiblingArtifactsIgnored:
                 {"pass": "concurrency", "rule": "unguarded-rmw", "line": 42}
             ],
             "concurrency": {"roots": [], "findings": 0},
+            # ISSUE 9: the report grew a pass-8 comm section whose
+            # per-scale records carry bytes_per_iter numbers — still an
+            # analysis artifact, still never mined.
+            "comm": {
+                "backends": {
+                    "tpu-sharded:tpu-csr": {
+                        "status": "checked",
+                        "scales": [{"bytes_per_iter": 4096}],
+                    }
+                }
+            },
         }
 
     def test_artifacts_beside_rounds_do_not_pollute_series(self, tmp_path):
@@ -274,3 +285,72 @@ class TestSiblingArtifactsIgnored:
             [REPO / "SANITIZER_asan_r01.json", REPO / "SANITIZER_tsan_r01.json"]
         )
         assert series == {}
+
+
+class TestCommBytesSeries:
+    """ISSUE 9: MULTICHIP_r*.json is in the default globs and its
+    pass-8 comm scrape feeds a ``comm_bytes_per_iter`` series that
+    gates UPWARD — a partitioner surprise that inflates per-iteration
+    wire traffic is a regression like any wall-clock."""
+
+    METRIC = "per-iteration collective bytes (tpu-sharded:tpu-csr, 8-dev mesh)"
+
+    def _multichip(self, n: int, bytes_per_iter: float) -> dict:
+        return {
+            "n": n,
+            "n_devices": 8,
+            "ok": True,
+            "comm": {
+                "tpu-sharded:tpu-csr": {"bytes_per_iter": bytes_per_iter}
+            },
+            "entries": [
+                {
+                    "metric": self.METRIC,
+                    "comm_bytes_per_iter": bytes_per_iter,
+                    "unit": "bytes",
+                }
+            ],
+        }
+
+    def test_stable_comm_bytes_pass(self, tmp_path):
+        for i in (1, 2):
+            (tmp_path / f"MULTICHIP_r{i:02d}.json").write_text(
+                json.dumps(self._multichip(i, 2048.0))
+            )
+        out = tmp_path / "s.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        key = f"{self.METRIC} :: comm_bytes_per_iter"
+        assert report["series"][key]["rounds"] == 2
+        assert report["series"][key]["lower_is_better"] is True
+
+    def test_inflated_comm_bytes_fail(self, tmp_path):
+        for i, b in ((1, 2048.0), (2, 4096.0)):  # 2x wire out of nowhere
+            (tmp_path / f"MULTICHIP_r{i:02d}.json").write_text(
+                json.dumps(self._multichip(i, b))
+            )
+        out = tmp_path / "s.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1
+        report = json.loads(out.read_text())
+        assert report["regressions"] == [
+            f"{self.METRIC} :: comm_bytes_per_iter"
+        ]
+
+    def test_legacy_multichip_rounds_yield_no_series(self):
+        """The pre-ISSUE-9 MULTICHIP_r01..r05 records (rc/ok/tail only)
+        are in the default globs but carry no metric entries — they
+        must contribute nothing rather than break the parse."""
+        series = perf_sentinel.collect_series([REPO / "MULTICHIP_r01.json"])
+        assert series == {}
+
+    def test_committed_multichip_round_feeds_the_gate(self, tmp_path):
+        """The ISSUE 9 recorded round (MULTICHIP_r06+) is picked up by
+        the default-glob run as a comm_bytes_per_iter series."""
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(REPO), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert any("MULTICHIP_r06.json" in f for f in report["history_files"])
+        assert any("comm_bytes_per_iter" in k for k in report["series"])

@@ -1,0 +1,205 @@
+"""Multi-chip dry run + pass-8 comm scrape -> ``MULTICHIP_r<N>.json``.
+
+The driver's ``dryrun_multichip`` entry (``__graft_entry__.py``) proves
+the sharded path *computes* correctly on an n-device mesh; this tool
+runs the same dual-kernel dry run and additionally records what the
+run *communicates*: for each sharded composite it scrapes the compiled
+module with the graftlint pass-8 walker and persists the per-epoch
+collective table — kind, replica groups, per-iteration byte volume —
+next to the correctness verdict.  The ``entries`` list is shaped for
+``tools/perf_sentinel.py``, which tracks ``comm_bytes_per_iter`` as a
+per-metric series: a PR that silently inflates wire traffic (a
+partitioner surprise at a new jax pin, a resharding regression) now
+moves a recorded number, not just a lint bit.
+
+Self-provisions the mesh exactly like the driver entry: without enough
+real devices it re-execs itself on a virtual CPU mesh.
+
+Run: ``python tools/dryrun_multichip.py [--devices 8] [--out PATH]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _next_round_path() -> str:
+    """``MULTICHIP_r<N>.json`` with N following the highest recorded
+    multichip round (same convention as bench.py's ladder rounds)."""
+    rounds = [0]
+    for p in REPO.glob("MULTICHIP_r*.json"):
+        m = re.fullmatch(r"MULTICHIP_r(\d+)\.json", p.name)
+        if m:
+            rounds.append(int(m.group(1)))
+    return str(REPO / f"MULTICHIP_r{max(rounds) + 1:02d}.json")
+
+
+def _scrape(backend: str, lowered) -> dict:
+    """Pass-8 walk of one compiled runner module."""
+    from protocol_tpu.analysis.comm.hlo_walk import parse_module
+
+    mod = parse_module(lowered.compile().as_text())
+    return {
+        "collectives": [op.to_dict() for op in mod.collectives],
+        "bytes_per_iter": mod.total_bytes(per_iteration_only=True),
+        "input_output_alias": {
+            str(k): v for k, v in sorted(mod.aliases.items())
+        },
+        "host_round_trips": len(mod.host_calls),
+    }
+
+
+def _body(n_devices: int, n_peers: int, n_edges: int, iters: int) -> dict:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from protocol_tpu.models.graphs import scale_free
+    from protocol_tpu.parallel.mesh import default_mesh
+    from protocol_tpu.parallel.sharded import (
+        ShardedTrustProblem,
+        ShardedWindowPlan,
+        _get_runner,
+        _get_windowed_runner,
+        converge_sharded,
+    )
+
+    mesh = default_mesh(n_devices)
+    graph = scale_free(n_peers, n_edges, seed=1)
+    alpha = jnp.asarray(0.1, jnp.float32)
+
+    prob = ShardedTrustProblem.build(graph, mesh)
+    t, iters_run, resid = converge_sharded(
+        prob, alpha=0.1, tol=1e-6, max_iter=iters
+    )
+    scores = np.asarray(t)
+    assert scores.shape == (graph.n,)
+    assert abs(float(scores.sum()) - 1.0) < 1e-3
+
+    swp = ShardedWindowPlan.build(graph, mesh)
+    tw, _, _ = converge_sharded(swp, alpha=0.1, tol=1e-6, max_iter=iters)
+    drift = float(np.abs(np.asarray(tw) - scores).sum())
+    assert drift < 1e-4, f"windowed sharded kernel drifted from csr: {drift}"
+
+    csr_run = _get_runner(mesh, prob.n)
+    comm = {
+        "tpu-sharded:tpu-csr": _scrape(
+            "tpu-sharded:tpu-csr",
+            csr_run.lower(
+                prob.src, prob.w, prob.row_ptr, prob.t0(), prob.p,
+                prob.dangling, alpha, max_iter=iters, tol=1e-6,
+            ),
+        )
+    }
+    win_run = _get_windowed_runner(
+        mesh, swp.n, swp.rows_per_shard, swp.table_entries, swp.interpret
+    )
+    comm["tpu-sharded:tpu-windowed"] = _scrape(
+        "tpu-sharded:tpu-windowed",
+        win_run.lower(
+            swp.wid, swp.local, swp.weight, swp.seg_end, swp.seg_first,
+            swp.seg_perm, swp.dst_ptr, swp.t0(), swp.p, swp.dangling,
+            alpha, max_iter=iters, tol=1e-6,
+        ),
+    )
+
+    entries = [
+        {
+            "metric": (
+                f"per-iteration collective bytes ({backend}, "
+                f"{n_devices}-dev mesh, {graph.n} peers/{n_edges} edges)"
+            ),
+            "comm_bytes_per_iter": scraped["bytes_per_iter"],
+            "unit": "bytes",
+        }
+        for backend, scraped in comm.items()
+    ]
+    return {
+        "n_devices": n_devices,
+        "rc": 0,
+        "ok": True,
+        "skipped": False,
+        "n_peers": graph.n,
+        "n_edges": int(n_edges),
+        "iterations": int(iters_run),
+        "residual": float(resid),
+        "windowed_vs_csr_l1": drift,
+        "comm": comm,
+        "entries": entries,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--peers", type=int, default=512)
+    ap.add_argument("--edges", type=int, default=4096)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument(
+        "--out",
+        default=None,
+        help="report path (default: MULTICHIP_r<N>.json, next round)",
+    )
+    args = ap.parse_args(argv)
+    out = args.out or _next_round_path()
+
+    import jax
+
+    if len(jax.devices()) < args.devices:
+        # Not enough real devices — re-exec on a virtual CPU mesh (the
+        # __graft_entry__.dryrun_multichip doctrine: the env var alone
+        # is not enough when a site hook pins the platform, so the
+        # child also overrides jax_platforms before backend init).
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flag = f"--xla_force_host_platform_device_count={args.devices}"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = (flags + " " + flag).strip()
+        env["XLA_FLAGS"] = flags
+        env["PROTOCOL_TPU_MULTICHIP_CHILD"] = "1"
+        proc = subprocess.run(
+            [
+                sys.executable, __file__,
+                "--devices", str(args.devices),
+                "--peers", str(args.peers),
+                "--edges", str(args.edges),
+                "--iters", str(args.iters),
+                "--out", out,
+            ],
+            env=env,
+            cwd=REPO,
+        )
+        return proc.returncode
+
+    if os.environ.get("PROTOCOL_TPU_MULTICHIP_CHILD"):
+        jax.config.update("jax_platforms", "cpu")
+
+    report = _body(args.devices, args.peers, args.edges, args.iters)
+    Path(out).write_text(json.dumps(report, indent=2) + "\n")
+    bytes_by_backend = {
+        b: c["bytes_per_iter"] for b, c in report["comm"].items()
+    }
+    print(
+        f"dryrun_multichip: {args.devices} devices, n={report['n_peers']}, "
+        f"{report['iterations']} iters, residual {report['residual']:.2e}, "
+        f"windowed drift {report['windowed_vs_csr_l1']:.2e}, "
+        f"collective bytes/iter {bytes_by_backend} — OK ({out})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,12 +1,14 @@
 """Perf-regression sentinel over the committed bench trajectory.
 
 The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
-``LADDER_r*.json``, and since ISSUE 7 the ingest-storm rounds
-``INGEST_r*.json``) but nothing ever *read* the series — a PR could
+``LADDER_r*.json``, since ISSUE 7 the ingest-storm rounds
+``INGEST_r*.json``, and since ISSUE 9 the multichip comm rounds
+``MULTICHIP_r*.json``) but nothing ever *read* the series — a PR could
 halve headline throughput and no gate would notice.  This tool closes
 the loop: it parses the recorded rounds into per-metric series
 (headline convergence seconds, cold/steady-state epoch seconds, plan
-build seconds, sigs/s, power-iters/s, p99 admission latency), optionally
+build seconds, sigs/s, power-iters/s, p99 admission latency,
+per-iteration collective bytes), optionally
 folds in a fresh bench entry, and exits non-zero when the newest value
 regresses more than ``--threshold`` against the best value the repo has
 ever recorded.
@@ -48,6 +50,10 @@ _FIELDS = {
     "sigs_per_s": False,
     "power_iters_per_sec": False,
     "p99_admission_ms": True,
+    # Pass-8 comm scrape (MULTICHIP/LADDER rounds): per-iteration
+    # collective wire volume of the sharded composites — a partitioner
+    # surprise that inflates traffic regresses this series upward.
+    "comm_bytes_per_iter": True,
 }
 
 
@@ -227,7 +233,7 @@ def main(argv: list[str] | None = None) -> int:
         action="append",
         default=None,
         help="history filename glob(s); default: BENCH_r*.json, "
-        "LADDER_r*.json, and INGEST_r*.json",
+        "LADDER_r*.json, INGEST_r*.json, and MULTICHIP_r*.json",
     )
     ap.add_argument(
         "--fresh",
@@ -246,7 +252,12 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     root = Path(args.history) if args.history else Path(__file__).resolve().parent.parent
-    patterns = args.glob or ["BENCH_r*.json", "LADDER_r*.json", "INGEST_r*.json"]
+    patterns = args.glob or [
+        "BENCH_r*.json",
+        "LADDER_r*.json",
+        "INGEST_r*.json",
+        "MULTICHIP_r*.json",
+    ]
     paths = [
         Path(p) for pat in patterns for p in globlib.glob(str(root / pat))
     ]
