@@ -76,6 +76,20 @@ Pass 6 — the admission-plane boundary rule (ISSUE 7):
   bounded queues; a signature check or an unbounded enqueue on the
   epoch path would re-couple the convergence cadence to ingest load
   — exactly the contention the admission tier exists to remove.
+
+Pass 9 — the proving-plane boundary rule (ISSUE 10):
+
+- ``blocking-prove-in-epoch-loop`` (error): a synchronous prover
+  entry point (``plonk.prove`` / ``prover.prove`` /
+  ``calculate_proofs`` / ``prove_epoch_statement`` /
+  ``aggregate_proofs`` or the aggregator's ``accumulate``) inside
+  the epoch-loop code paths (``node/epoch.py`` /
+  ``node/pipeline.py``).  A SNARK is seconds of whole-core native
+  work; on the epoch path it re-serializes proving into the epoch
+  cadence — the exact coupling the async proving plane
+  (``protocol_tpu/prover/``) exists to remove.  Epoch-loop code
+  enqueues a :class:`~protocol_tpu.prover.jobs.ProofJob` and moves
+  on; proving belongs in the plane's worker pool.
 """
 
 from __future__ import annotations
@@ -267,6 +281,26 @@ def _is_sync_verify_call(name: str | None) -> bool:
     return name is not None and name.rsplit(".", 1)[-1] in _SYNC_VERIFY_LEAVES
 
 
+#: Synchronous proving entry points (pass 9): the PLONK/commitment
+#: prove surface, the statement synthesizer, and the aggregator —
+#: seconds of whole-core native work that must never run on the epoch
+#: loop's critical path (the proving plane's job queue is the only
+#: sanctioned hand-off).  ``submit``/``prove_job`` via the plane pass.
+_SYNC_PROVE_LEAVES = frozenset(
+    {
+        "prove",
+        "calculate_proofs",
+        "prove_epoch_statement",
+        "aggregate_proofs",
+        "accumulate",
+    }
+)
+
+
+def _is_sync_prove_call(name: str | None) -> bool:
+    return name is not None and name.rsplit(".", 1)[-1] in _SYNC_PROVE_LEAVES
+
+
 def _is_unbounded_put(node: ast.Call, name: str | None) -> bool:
     """``<q>.put(item)`` with neither ``block=False`` nor a
     ``timeout=`` — a potentially unbounded block.  ``put_nowait`` and
@@ -446,6 +480,19 @@ class _Visitor(ast.NodeVisitor):
                     "enqueue can stall the epoch loop indefinitely — "
                     "use put_nowait (coalescing backpressure) or a "
                     "bounded timeout",
+                    node,
+                )
+            elif _is_sync_prove_call(name):
+                # Pass 9: the epoch loop never proves synchronously —
+                # a SNARK is seconds of whole-core work; enqueue a
+                # ProofJob on the proving plane instead.
+                self._emit(
+                    "blocking-prove-in-epoch-loop",
+                    f"{name}() on an epoch-loop code path: synchronous "
+                    "proving re-serializes the SNARK into the epoch "
+                    "cadence — enqueue a ProofJob on the proving plane "
+                    "(protocol_tpu/prover/) and let the worker pool "
+                    "prove it off the critical path",
                     node,
                 )
         if (

@@ -61,15 +61,20 @@ _FANOUT_CAP = 6
 
 #: Trees whose class instances are *thread-confined by design* — each
 #: object is constructed and used within a single thread of control
-#: (the prover/zk stack is owned by whichever epoch stage runs it, the
-#: EVM devchain and client are test/tooling drivers, crypto objects
-#: are per-call).  The shared-state rules (mixed-guard / RMW /
+#: (the EVM devchain and client are test/tooling drivers, crypto
+#: objects are per-call).  The shared-state rules (mixed-guard / RMW /
 #: check-then-act) skip classes defined here; the lock-order and
 #: blocking-under-lock rules still apply.  This is a declared policy,
-#: recorded in the ANALYSIS.json concurrency section — revisit when
-#: the async prover pool (ROADMAP item 1) makes zk/ objects shared.
+#: recorded in the ANALYSIS.json concurrency section.
+#:
+#: zk/ left this list at the prover pool (ISSUE 10, closing PR 8's
+#: recorded revisit): the proving plane's dispatcher threads, the
+#: ingest dispatchers (batch crypto), and the /aggregate executor now
+#: all reach the zk bridge — prover *instances* stay confined to one
+#: dispatcher (or one worker process), but module state like
+#: ``zk/native.py``'s loader globals is genuinely shared and now
+#: analyzed (the loader grew its one-time-init lock in this PR).
 _CONFINED_TREES = (
-    "protocol_tpu/zk/",
     "protocol_tpu/evm/",
     "protocol_tpu/client/",
     "protocol_tpu/crypto/",

@@ -62,6 +62,35 @@ WAIVERS: tuple[Waiver, ...] = (
     ),
     Waiver(
         rule="unguarded-rmw",
+        file="protocol_tpu/zk/cs.py",
+        symbol="ConstraintSystem.n_rows",
+        reason=(
+            "zk/ stopped being tree-confined at the prover pool "
+            "(ISSUE 10), but a ConstraintSystem is still *instance*-"
+            "confined: each one is synthesized and consumed by exactly "
+            "one prove path — one plane dispatcher thread, one worker "
+            "process, or one /aggregate executor call — and never "
+            "escapes it.  The genuinely shared zk state (the "
+            "zk/native.py loader globals) grew a real lock instead.  "
+            "The pooled-vs-inline bit-equality test would catch any "
+            "cross-thread sharing regression as a torn row count."
+        ),
+    ),
+    Waiver(
+        rule="check-then-act",
+        file="protocol_tpu/zk/plonk.py",
+        symbol="_CosetEvaluator._shift_pows",
+        reason=(
+            "Per-prove lazy memo: a _CosetEvaluator lives inside one "
+            "prove() call (one dispatcher thread or worker process); "
+            "the flag flip can never race because the instance never "
+            "crosses a thread.  Same instance-confinement argument as "
+            "ConstraintSystem.n_rows — recorded, not locked, to keep "
+            "the MSM-adjacent hot path allocation-free."
+        ),
+    ),
+    Waiver(
+        rule="unguarded-rmw",
         file="protocol_tpu/obs/journal.py",
         symbol="FlightRecorder._seq",
         reason=(

@@ -273,6 +273,23 @@ def _blocking_ingest_in_epoch_loop() -> tuple[str, str]:
     return _BLOCKING_INGEST_SRC, "protocol_tpu/node/pipeline.py"
 
 
+_BLOCKING_PROVE_SRC = '''\
+def device_stage(manager, prepared):
+    # A synchronous SNARK on the epoch path re-serializes seconds of
+    # whole-core proving into the epoch cadence — the coupling the
+    # async proving plane (protocol_tpu/prover/) exists to remove.
+    manager.calculate_proofs(prepared.epoch)  # VIOLATION: blocking-prove-in-epoch-loop
+    return prepared
+'''
+
+
+def _blocking_prove_in_epoch_loop() -> tuple[str, str]:
+    # Same file-scoped shape as pass 6: the fake path lands on an
+    # epoch-loop file so the pass-9 rule applies exactly as it would
+    # to the real module.
+    return _BLOCKING_PROVE_SRC, "protocol_tpu/node/pipeline.py"
+
+
 #: Pass-7 seeded violations (whole-program concurrency rules).  Each
 #: source is a self-contained "program": it declares its own thread
 #: roots, so the analyzer's reachability machinery runs exactly as it
@@ -654,6 +671,11 @@ FIXTURES: dict[str, Fixture] = {
         Fixture(
             "blocking-ingest-in-epoch-loop", "blocking-ingest-in-epoch-loop",
             _blocking_ingest_in_epoch_loop, "blocking-ingest-in-epoch-loop",
+            kind="ast",
+        ),
+        Fixture(
+            "blocking-prove-in-epoch-loop", "blocking-prove-in-epoch-loop",
+            _blocking_prove_in_epoch_loop, "blocking-prove-in-epoch-loop",
             kind="ast",
         ),
         Fixture(

@@ -63,6 +63,28 @@ class ProtocolConfig:
     #: "plonk" (real KZG SNARK per epoch, the reference's behavior) or
     #: "commitment" (fast Poseidon binding).
     prover: str = "plonk"
+    #: Async proving plane (protocol_tpu/prover/): the epoch tick ends
+    #: at converge → checkpoint and *enqueues* the SNARK onto a bounded
+    #: queue drained by a prover worker pool — a slow prover becomes
+    #: proof lag (eigentrust_proof_lag_epochs, GET /proof/<epoch>),
+    #: never epoch latency.  Off by default: the sequential tick keeps
+    #: the reference's proof-per-tick semantics on small nodes.
+    async_prover: bool = False
+    #: Prover worker processes (0 = prove inline on the plane's
+    #: dispatcher thread — still off the epoch tick, but sharing the
+    #: node process's GIL).  Each worker caches its SRS + proving key
+    #: across jobs and is prewarmed at boot.
+    prover_workers: int = 1
+    #: Proof jobs that may wait for a dispatcher; beyond it the oldest
+    #: queued job is superseded (latest-wins — an epoch tick never
+    #: blocks on the proof queue).
+    prover_queue_max: int = 1
+    #: Per-attempt prove timeout (seconds); a worker past it is killed
+    #: and the job retried, then failed with reason=prover-crashed.
+    prove_timeout_s: float = 900.0
+    #: OMP_NUM_THREADS for each prover worker's native MSM/NTT loops
+    #: (0 = runtime default).
+    prover_omp_threads: int = 0
     #: Ceremony SRS file for the PLONK prover (kzg.Setup format).
     srs_path: str | None = None
     #: Opt-in jax.profiler capture: device-timeline traces of each
@@ -120,6 +142,15 @@ class ProtocolConfig:
             obj.get("ingest_whitelist_pretrusted", cfg.ingest_whitelist_pretrusted)
         )
         cfg.prover = obj.get("prover", cfg.prover)
+        cfg.async_prover = bool(obj.get("async_prover", cfg.async_prover))
+        cfg.prover_workers = int(obj.get("prover_workers", cfg.prover_workers))
+        cfg.prover_queue_max = int(
+            obj.get("prover_queue_max", cfg.prover_queue_max)
+        )
+        cfg.prove_timeout_s = float(obj.get("prove_timeout_s", cfg.prove_timeout_s))
+        cfg.prover_omp_threads = int(
+            obj.get("prover_omp_threads", cfg.prover_omp_threads)
+        )
         cfg.srs_path = obj.get("srs_path", cfg.srs_path)
         cfg.profile_dir = obj.get("profile_dir", cfg.profile_dir)
         cfg.journal_path = obj.get("journal_path", cfg.journal_path)

@@ -380,6 +380,50 @@ class Manager:
             list(self.attestations[h].scores) for h in self._group_hashes
         ]
 
+    def build_proof_job(self, epoch: Epoch):
+        """Flatten this epoch's fixed-set statement into a
+        :class:`~protocol_tpu.prover.jobs.ProofJob` for the async
+        proving plane: per-member signature/pk/score integer tuples
+        plus the protocol parameters — no protocol objects cross the
+        worker process boundary.  The snapshot happens here, on the
+        epoch tick, so later ingests never mutate an enqueued job."""
+        from ..prover.jobs import ProofJob
+
+        cfg = self.config
+        atts = [self.attestations[h] for h in self._group_hashes]
+        with self._state_lock:
+            plan = self.window_plan
+        # Plan fingerprints are hex digests; fold to an int so the job
+        # payload stays flat ints (0 = no cached plan yet).
+        raw_fp = getattr(plan, "fingerprint", 0) or 0
+        fingerprint = int(raw_fp, 16) if isinstance(raw_fp, str) else int(raw_fp)
+        return ProofJob(
+            epoch=epoch.number,
+            ops=tuple(tuple(int(s) for s in a.scores) for a in atts),
+            sigs=tuple(
+                (a.sig.big_r.x, a.sig.big_r.y, a.sig.s) for a in atts
+            ),
+            pks=tuple((a.pk.point.x, a.pk.point.y) for a in atts),
+            params=(
+                cfg.num_neighbours,
+                cfg.num_iter,
+                cfg.initial_score,
+                cfg.scale,
+            ),
+            prover=cfg.prover,
+            srs_path=cfg.srs_path,
+            check_circuit=cfg.check_circuit,
+            graph_fingerprint=fingerprint,
+        )
+
+    def install_proof(self, epoch_number: int, pub_ins, proof_bytes: bytes) -> None:
+        """Land an asynchronously produced proof in the cache (called
+        from a proving-plane dispatcher thread; the dict insert is
+        GIL-atomic, same discipline as the attestation cache)."""
+        self.cached_proofs[Epoch(int(epoch_number))] = Proof(
+            pub_ins=list(pub_ins), proof=proof_bytes
+        )
+
     def calculate_proofs(self, epoch: Epoch) -> None:
         """Converge the fixed set exactly and cache a proof of the
         resulting public inputs (manager/mod.rs:170-214)."""
@@ -411,10 +455,15 @@ class Manager:
 
         # Proving time lands in telemetry, the structured analog of the
         # reference's "Proving time: {:?}" print (circuit/src/utils.rs:305-321).
+        from ..prover.jobs import job_seed
         from ..utils.telemetry import TELEMETRY
 
+        # The statement-bound blinding seed keeps the synchronous path
+        # byte-identical to the pooled path for the same input (the
+        # async-prover equivalence contract).
+        seed = job_seed(self.build_proof_job(epoch))
         with TELEMETRY.timer("epoch.prove"), TRACER.span("snark"):
-            proof_bytes = self.prover.prove(pub_ins, witness)
+            proof_bytes = self.prover.prove(pub_ins, witness, seed=seed)
         if __debug__:
             assert self.prover.verify(pub_ins, proof_bytes)
         self.cached_proofs[epoch] = Proof(pub_ins=pub_ins, proof=proof_bytes)

@@ -58,9 +58,47 @@ def _backend_tag(manager: Manager) -> str:
     return getattr(manager.prover, "wire_tag", "")
 
 
-def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
+def handle_request(
+    method: str, path: str, manager: Manager, plane=None
+) -> tuple[int, str]:
     """Route one request (main.rs:85-119 + the rebuild's observability
-    surface).  Returns (status, body)."""
+    surface).  Returns (status, body).  ``plane`` is the node's async
+    :class:`~protocol_tpu.prover.plane.ProvingPlane` (or None in
+    sequential-prove mode) — the ``/proof`` lifecycle source."""
+    if method == "GET" and path.startswith("/proof/"):
+        # /proof/<epoch> (or /proof/latest): the proof itself when it
+        # landed, else the job's lifecycle state (queued / proving /
+        # failed / superseded) — the async proving plane's contract
+        # that every epoch resolves explicitly, never silently.
+        arg = path.removeprefix("/proof/")
+        if arg == "latest":
+            cached = manager.cached_proofs
+            if cached:
+                arg = str(max(cached, key=lambda e: e.number).number)
+            elif plane is not None and plane.latest_epoch() is not None:
+                arg = str(plane.latest_epoch())
+            else:
+                return NOT_FOUND, json.dumps({"error": "no proofs yet"})
+        try:
+            epoch_number = int(arg)
+        except ValueError:
+            return BAD_REQUEST, "InvalidQuery"
+        proof = manager.cached_proofs.get(Epoch(epoch_number))
+        status_obj = plane.status(epoch_number) if plane is not None else None
+        if proof is not None:
+            body = json.loads(
+                proof.to_raw(backend=_backend_tag(manager)).to_json()
+            )
+            body["epoch"] = epoch_number
+            body["state"] = "proved"
+            if status_obj is not None:
+                body.update(status_obj.to_dict())
+            return 200, json.dumps(body)
+        if status_obj is not None:
+            return 200, json.dumps(status_obj.to_dict())
+        return NOT_FOUND, json.dumps(
+            {"epoch": epoch_number, "error": "no proof or proof job"}
+        )
     if method == "GET" and path == "/score":
         try:
             proof = manager.get_last_proof()
@@ -73,8 +111,6 @@ def handle_request(method: str, path: str, manager: Manager) -> tuple[int, str]:
         # cached epoch SNARKs (the aggregator surface the reference
         # never finished wiring).
         from urllib.parse import parse_qs, urlsplit
-
-        from .epoch import Epoch
 
         try:
             qs = parse_qs(urlsplit(path).query)
@@ -171,6 +207,11 @@ class Node:
     #: front of the Manager; POST /attestation and the chain-event
     #: stream both route through it.  None = legacy direct ingest.
     _ingest: object | None = field(default=None, repr=False)
+    #: Async proving plane (config.async_prover): epoch ticks enqueue
+    #: the SNARK; a spawn-based prover pool drains it and landed proofs
+    #: install into the Manager's cache from a dispatcher thread.
+    #: None = the sequential prove-per-tick path.
+    _prover_plane: object | None = field(default=None, repr=False)
 
     @classmethod
     def from_config(cls, config: ProtocolConfig) -> "Node":
@@ -227,10 +268,17 @@ class Node:
                     # event loop (reference stance: heavy work off-loop,
                     # like _epoch_tick).
                     status, body = await asyncio.get_running_loop().run_in_executor(
-                        None, handle_request, parts[0], parts[1], self.manager
+                        None,
+                        handle_request,
+                        parts[0],
+                        parts[1],
+                        self.manager,
+                        self._prover_plane,
                     )
                 else:
-                    status, body = handle_request(parts[0], parts[1], self.manager)
+                    status, body = handle_request(
+                        parts[0], parts[1], self.manager, self._prover_plane
+                    )
             payload = body.encode()
             content_type = (
                 PROMETHEUS_CONTENT_TYPE
@@ -306,8 +354,8 @@ class Node:
         boundaries, so the tree costs a few context-manager entries per
         epoch and nothing inside the jit'd loop."""
         with TRACER.epoch(epoch.number):
-            with TELEMETRY.timer("epoch.calculate_proofs"), TRACER.span("prove"):
-                self.manager.calculate_proofs(epoch)
+            if self._prover_plane is None:
+                self._prove_or_enqueue(epoch)
             scores = None
             if self.manager.config.backend != "native-cpu":
                 # Opt-in jax.profiler session (ProtocolConfig.profile_dir):
@@ -332,12 +380,36 @@ class Node:
                     result.backend,
                 )
             self._checkpoint_epoch(epoch, scores)
+            if self._prover_plane is not None:
+                # Async mode enqueues at tick END: the job snapshot is
+                # the tick's final state, and the prove starts once the
+                # tick's own CPU burst (converge + checkpoint) is done
+                # — on a small host the worker gets the inter-tick gap
+                # instead of time-slicing against converge.
+                self._prove_or_enqueue(epoch)
         TELEMETRY.count("epochs")
         obs_metrics.EPOCHS_TOTAL.inc()
         if self._ingest is not None:
             # Epoch-aligned dedup eviction: "recent" replays are those
             # inside the horizon that could still perturb convergence.
             self._ingest.advance_epoch()
+
+    def _prove_or_enqueue(self, epoch: Epoch) -> None:
+        """The epoch tick's proof step.  Sequential mode runs the full
+        prove inline (reference semantics: a proof per tick before the
+        tick ends).  With the async proving plane, the tick only
+        *snapshots* the statement and enqueues it — microseconds — and
+        the SNARK runs in a prover worker while the epoch loop moves
+        on; the landed proof installs into the cache from a dispatcher
+        thread and its attribution grafts back into this epoch's
+        trace."""
+        if self._prover_plane is None:
+            with TELEMETRY.timer("epoch.calculate_proofs"), TRACER.span("prove"):
+                self.manager.calculate_proofs(epoch)
+            return
+        with TRACER.span("prove_enqueue"):
+            status = self._prover_plane.submit(self.manager.build_proof_job(epoch))
+        log.info("epoch %s: proof job enqueued (state=%s)", epoch, status.state)
 
     def _checkpoint_epoch(self, epoch: Epoch, scores) -> None:
         """Snapshot the epoch (graph + scores + proof + windowed plan +
@@ -354,11 +426,18 @@ class Node:
         graph = (
             self.manager.last_graph if scores is not None else self.manager.build_graph()
         )
-        proof_json = (
-            self.manager.get_proof(epoch)
-            .to_raw(backend=_backend_tag(self.manager))
-            .to_json()
-        )
+        # Async proving: the proof usually hasn't landed by checkpoint
+        # time (that's the point) — snapshot without it; the proof is
+        # re-derivable from the attestation stream and served from the
+        # cache once the plane lands it.
+        try:
+            proof_json = (
+                self.manager.get_proof(epoch)
+                .to_raw(backend=_backend_tag(self.manager))
+                .to_json()
+            )
+        except EigenError:
+            proof_json = None
         with TELEMETRY.timer("epoch.checkpoint"), TRACER.span("checkpoint"):
             CheckpointStore(self.config.checkpoint_dir).save(
                 epoch,
@@ -381,8 +460,8 @@ class Node:
         runs, the next epoch's host stage may already be executing."""
         epoch = prepared.epoch
         with TRACER.epoch(epoch.number):
-            with TELEMETRY.timer("epoch.calculate_proofs"), TRACER.span("prove"):
-                self.manager.calculate_proofs(epoch)
+            if self._prover_plane is None:
+                self._prove_or_enqueue(epoch)
             scores = None
             result = None
             if self.manager.config.backend != "native-cpu":
@@ -405,6 +484,10 @@ class Node:
                     " [warm]" if prepared.t0 is not None else "",
                 )
             self._checkpoint_epoch(epoch, scores)
+            if self._prover_plane is not None:
+                # Tick-end enqueue (see _epoch_tick): the prove gets
+                # the inter-tick gap, never this tick's core budget.
+                self._prove_or_enqueue(epoch)
         TELEMETRY.count("epochs")
         obs_metrics.EPOCHS_TOTAL.inc()
         if self._ingest is not None:
@@ -615,6 +698,45 @@ class Node:
             self._pipeline = EpochPipeline(
                 self.manager, device_stage=self._pipeline_device_stage
             ).start()
+        if self.config.async_prover:
+            from ..prover import ProvingPlane, ProvingPlaneConfig
+
+            manager = self.manager
+
+            def _install(result) -> None:
+                manager.install_proof(result.epoch, result.pub_ins, result.proof)
+
+            self._prover_plane = ProvingPlane(
+                ProvingPlaneConfig(
+                    workers=self.config.prover_workers,
+                    queue_depth=self.config.prover_queue_max,
+                    prove_timeout_s=self.config.prove_timeout_s,
+                    omp_threads=self.config.prover_omp_threads,
+                ),
+                on_proved=_install,
+            ).start()
+            # Worker SRS/proving-key prewarm runs off-loop with the
+            # parent keygen below: the parent writes the disk key cache
+            # first (so every worker loads the SAME key), then each
+            # worker warms from it — steady-state jobs pay no setup.
+            cfg = self.manager.config
+            plane = self._prover_plane
+            asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: (
+                    manager.warm_prover(),
+                    plane.prewarm(
+                        (
+                            cfg.num_neighbours,
+                            cfg.num_iter,
+                            cfg.initial_score,
+                            cfg.scale,
+                        ),
+                        cfg.prover,
+                        cfg.srs_path,
+                    ),
+                ),
+            )
         # Boot-time keygen, like the reference's MANAGER_STORE init
         # (server/src/main.rs:70-83): runs in an executor so the HTTP
         # socket comes up while the (cached ~0.7 s / cold ~13 s) PLONK
@@ -647,6 +769,12 @@ class Node:
             # worker; run off-loop so a slow prover can't stall stop().
             await asyncio.get_running_loop().run_in_executor(
                 None, lambda: self._pipeline.close(drain=True, timeout=30.0)
+            )
+        if self._prover_plane is not None:
+            # Queued/in-flight proofs get a bounded window to land;
+            # stragglers resolve with an explicit terminal state.
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._prover_plane.close(drain=True, timeout=30.0)
             )
         if self._server:
             self._server.close()

@@ -419,6 +419,45 @@ INGEST_WORKER_RESTARTS = METRICS.counter(
     "eigentrust_ingest_worker_restarts_total",
     "Verify worker-pool rebuilds after a worker process died",
 )
+PROOF_LAG_EPOCHS = METRICS.gauge(
+    "eigentrust_proof_lag_epochs",
+    "Newest submitted epoch minus newest proved epoch in the async "
+    "proving plane — 0 when proving keeps up with the epoch cadence, "
+    "growing when the prover falls behind (the decoupling's health "
+    "headline: a slow prover is lag here, never epoch latency)",
+)
+PROOF_QUEUE_DEPTH = METRICS.gauge(
+    "eigentrust_proof_queue_depth",
+    "Proof jobs waiting between an epoch tick's enqueue and a prover "
+    "dispatcher (bounded; at the bound the oldest queued job is "
+    "superseded, latest-wins)",
+)
+PROVE_SECONDS = METRICS.histogram(
+    "eigentrust_prove_seconds",
+    "Wall-clock of one epoch proof (power_iterate + circuit check + "
+    "SNARK) inside a prover worker",
+    buckets=TIME_BUCKETS,
+)
+PROOFS_COMPLETED = METRICS.counter(
+    "eigentrust_proofs_completed_total",
+    "Proof jobs that reached state=proved (proof installed and served)",
+)
+PROOFS_FAILED = METRICS.counter(
+    "eigentrust_proofs_failed_total",
+    "Proof jobs that reached state=failed (prover crashed or timed "
+    "out past its retries; reason=prover-crashed)",
+)
+PROOFS_SUPERSEDED = METRICS.counter(
+    "eigentrust_proofs_superseded_total",
+    "Queued proof jobs displaced by a newer epoch under proving-plane "
+    "backpressure (latest-wins coalescing; explicit, never a silent "
+    "drop)",
+)
+PROVER_WORKER_RESTARTS = METRICS.counter(
+    "eigentrust_prover_worker_restarts_total",
+    "Prover worker-pool rebuilds after a worker process died or hung "
+    "past the per-job timeout",
+)
 LOCK_WAIT_SECONDS = METRICS.histogram(
     "eigentrust_lock_wait_seconds",
     "Lock-acquisition wait time by allocation site — recorded only "
@@ -469,5 +508,12 @@ __all__ = [
     "INGEST_ADMISSION_SECONDS",
     "INGEST_VERIFY_BATCHES",
     "INGEST_WORKER_RESTARTS",
+    "PROOF_LAG_EPOCHS",
+    "PROOF_QUEUE_DEPTH",
+    "PROVE_SECONDS",
+    "PROOFS_COMPLETED",
+    "PROOFS_FAILED",
+    "PROOFS_SUPERSEDED",
+    "PROVER_WORKER_RESTARTS",
     "LOCK_WAIT_SECONDS",
 ]

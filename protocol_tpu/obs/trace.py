@@ -96,6 +96,12 @@ class Tracer:
     def __init__(self, keep_epochs: int = 16):
         self.keep_epochs = keep_epochs
         self._traces: dict[int, dict[str, Any]] = {}
+        #: Graft payloads that arrived before their epoch's trace was
+        #: stored (an async proof can land while its epoch's root span
+        #: is still open — e.g. a fast prove against a cold-compile
+        #: converge); applied when the trace stores, bounded like the
+        #: trace ring.
+        self._pending_grafts: dict[int, list[tuple[dict[str, Any], str | None]]] = {}
         self._lock = threading.Lock()
         #: Called with every closed span (package wiring feeds the
         #: phase-seconds histogram).  Must be cheap and never raise.
@@ -192,9 +198,70 @@ class Tracer:
             _current_epoch.reset(token)
             if root is not None:
                 with self._lock:
-                    self._traces[epoch_number] = root.to_dict()
+                    trace = root.to_dict()
+                    self._traces[epoch_number] = trace
+                    # Early-arrived grafts (a proof that landed while
+                    # this root span was still open) attach now.
+                    for span_dict, parent_name in self._pending_grafts.pop(
+                        epoch_number, ()
+                    ):
+                        self._graft_locked(trace, span_dict, parent_name)
                     while len(self._traces) > self.keep_epochs:
                         del self._traces[min(self._traces)]
+
+    def graft(
+        self,
+        epoch_number: int,
+        span_dict: dict[str, Any],
+        parent_name: str | None = None,
+    ) -> bool:
+        """Attach an already-serialized span tree to a *stored* epoch
+        trace — the bridge for work that finishes after its epoch's
+        root span closed (the async proving plane: a worker process
+        proves epoch k seconds after epoch k's tick stored its trace,
+        and its ``prove{power_iterate, circuit_check, snark{...}}``
+        tree lands here so ``GET /trace/<epoch>`` keeps the deep
+        attribution).  ``parent_name`` picks a descendant to graft
+        under (first match, depth-first); default is the root.
+        A graft for an epoch whose trace is not stored *yet* (the root
+        span may still be open — a fast prove can beat a cold-compile
+        tick) is parked and applied when the trace stores; grafts for
+        ring-evicted epochs are dropped.  Returns whether the graft
+        landed immediately — parked/dropped grafts return False; the
+        graft is best-effort, like all telemetry."""
+        with self._lock:
+            epoch_number = int(epoch_number)
+            trace = self._traces.get(epoch_number)
+            if trace is None:
+                if not self._traces or epoch_number >= min(self._traces):
+                    self._pending_grafts.setdefault(epoch_number, []).append(
+                        (dict(span_dict), parent_name)
+                    )
+                    while len(self._pending_grafts) > self.keep_epochs:
+                        del self._pending_grafts[min(self._pending_grafts)]
+                return False
+            return self._graft_locked(trace, span_dict, parent_name)
+
+    @staticmethod
+    def _graft_locked(
+        trace: dict[str, Any],
+        span_dict: dict[str, Any],
+        parent_name: str | None,
+    ) -> bool:
+        def find(node: dict[str, Any], name: str) -> dict[str, Any] | None:
+            for child in node.get("children", ()):
+                if child.get("name") == name:
+                    return child
+                hit = find(child, name)
+                if hit is not None:
+                    return hit
+            return None
+
+        target = trace if parent_name is None else find(trace, parent_name)
+        if target is None:
+            return False
+        target.setdefault("children", []).append(dict(span_dict))
+        return True
 
     # -- queries --------------------------------------------------------
 

@@ -1,0 +1,68 @@
+"""The asynchronous proving plane (ISSUE 10, ROADMAP item 1b).
+
+With warm start landed, ``prove{power_iterate, circuit_check, snark}``
+became the dominant steady-state epoch phase — ~8-9 s of MSM-heavy
+native work serialized inside every epoch tick (PERF.md §12).  This
+package takes the SNARK off the epoch critical path: the device stage
+ends at ``converge → checkpoint`` and *enqueues* a proving job; a
+spawn-based worker pool proves it concurrently, and a slow prover
+shows up as bounded, observable *proof lag* instead of epoch latency.
+
+- :mod:`~protocol_tpu.prover.jobs` — the flat, picklable
+  :class:`ProofJob` payload (ints and tuples only, so workers import
+  just the zk/crypto tree), deterministic blinding seeds (pooled and
+  in-process proofs are bit-identical), and :func:`prove_job`, the
+  shared prove entry that returns the proof together with its
+  serialized span tree (PR 6's attribution crosses the process
+  boundary);
+- :mod:`~protocol_tpu.prover.workers` — the ingest-style spawn pool:
+  per-worker SRS/proving-key cache with pool-start prewarm, per-job
+  timeout, generation-guarded executor rebuild on crash, bounded
+  retries — a dead prover fails a job with a reason code, never
+  silently;
+- :mod:`~protocol_tpu.prover.plane` — the lifecycle
+  (``queued → proving → proved | failed | superseded``) behind a
+  bounded queue with latest-wins coalescing (the EpochPipeline's
+  supersede semantics), dispatcher threads, proof-lag/queue-depth/
+  prove-seconds metrics, and the span graft back into the epoch's
+  stored trace.
+
+``GET /proof/<epoch>`` serves the lifecycle; graftlint pass 9
+(``blocking-prove-in-epoch-loop``) pins the converse — the epoch-loop
+files must never call a prover synchronously again.
+"""
+
+from .jobs import (
+    CRASH_MARKER,
+    FAILED,
+    PROVED,
+    PROVING,
+    QUEUED,
+    SUPERSEDED,
+    ProofJob,
+    ProofResult,
+    crash_once_marker,
+    job_seed,
+    prove_job,
+)
+from .plane import ProofStatus, ProvingPlane, ProvingPlaneConfig
+from .workers import ProverCrashed, ProverPool
+
+__all__ = [
+    "CRASH_MARKER",
+    "FAILED",
+    "PROVED",
+    "PROVING",
+    "QUEUED",
+    "SUPERSEDED",
+    "ProofJob",
+    "ProofResult",
+    "ProofStatus",
+    "ProverCrashed",
+    "ProverPool",
+    "ProvingPlane",
+    "ProvingPlaneConfig",
+    "crash_once_marker",
+    "job_seed",
+    "prove_job",
+]

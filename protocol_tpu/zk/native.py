@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -35,6 +36,13 @@ _NATIVE_DIR = (
 )
 _LIB_PATH = _NATIVE_DIR / "libzk_runtime.so"
 _lib = None  # None = untried, False = failed, else CDLL
+#: One-time loader guard.  zk/ stopped being thread-confined at the
+#: prover pool (ISSUE 10): the proving plane's dispatcher threads, the
+#: ingest dispatchers (via batch crypto), and the /aggregate executor
+#: all race the first ``_load()`` — two unguarded loaders could each
+#: run the make rebuild and publish different CDLL objects mid-setup.
+#: The double-checked fast path keeps steady-state calls lock-free.
+_load_lock = threading.Lock()
 
 _I64P = ctypes.POINTER(ctypes.c_int64)
 
@@ -57,6 +65,20 @@ def _rebuild():
 
 
 def _load():
+    global _lib
+    # Lock-free fast path: after the one-time publish, _lib is a
+    # fully-initialized CDLL and readers never contend.
+    if _lib is False:
+        raise OSError("zk native runtime unavailable (previous build failed)")
+    if _lib is not None:
+        return _lib
+    with _load_lock:
+        return _load_locked()
+
+
+def _load_locked():
+    """The slow path, serialized: build/ABI-check/bind exactly once —
+    callers re-check ``_lib`` under the lock (double-checked init)."""
     global _lib
     if _lib is False:
         raise OSError("zk native runtime unavailable (previous build failed)")

@@ -71,7 +71,14 @@ class Prover:
     #: shape detection.
     wire_tag = ""
 
-    def prove(self, pub_ins: list[int], witness: dict) -> bytes:
+    def prove(
+        self, pub_ins: list[int], witness: dict, *, seed: bytes | None = None
+    ) -> bytes:
+        """``seed`` (optional) derives the blinding randomness
+        deterministically — the async proving plane passes a
+        statement-bound seed (:func:`protocol_tpu.prover.jobs.job_seed`)
+        so pooled and in-process proofs of the same statement are
+        byte-identical.  None keeps system-RNG blinding."""
         raise NotImplementedError
 
     def verify(self, pub_ins: list[int], proof: bytes) -> bool:
@@ -290,7 +297,9 @@ class PlonkEpochProver(Prover):
     #: proofs (verifier/mod.rs:70-83).
     TRANSCRIPT = "keccak"
 
-    def prove(self, pub_ins: list[int], witness: dict) -> bytes:
+    def prove(
+        self, pub_ins: list[int], witness: dict, *, seed: bytes | None = None
+    ) -> bytes:
         # Reuse a pre-synthesized constraint system (the manager's
         # check_circuit pass) rather than rebuilding the k=14 circuit.
         cs = witness.get("cs")
@@ -298,7 +307,9 @@ class PlonkEpochProver(Prover):
             cs = self._prove_statement(
                 witness["attestations"], pub_ins, **self._params
             )
-        return self._plonk.prove(self._pk, cs, pub_ins, transcript=self.TRANSCRIPT)
+        return self._plonk.prove(
+            self._pk, cs, pub_ins, seed=seed, transcript=self.TRANSCRIPT
+        )
 
     def verify(self, pub_ins: list[int], proof: bytes) -> bool:
         return self._plonk.verify(
@@ -350,7 +361,11 @@ class PoseidonCommitmentProver(Prover):
                 acc = permute([acc, x, 2, 0, 0])[0]
         return acc
 
-    def prove(self, pub_ins: list[int], witness: dict) -> bytes:
+    def prove(
+        self, pub_ins: list[int], witness: dict, *, seed: bytes | None = None
+    ) -> bytes:
+        # Commitment proofs are deterministic already; seed is accepted
+        # for interface uniformity and ignored.
         return field.to_le_bytes(self._digest(pub_ins, witness)) + json.dumps(
             {"ops": [[int(x) for x in row] for row in witness.get("ops", [])]}
         ).encode()

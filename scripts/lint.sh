@@ -29,12 +29,13 @@ python -m protocol_tpu.analysis --output ANALYSIS.json
 
 # Trees held to the hard format/type gates: the convergence-kernel,
 # backend, mesh-parallel, node, analyzer, observability, crypto, zk,
-# and admission-plane code.  crypto/ and zk/ were promoted from
-# informational with the analyzer work; obs/ joined with the telemetry
-# subsystem (ISSUE 4); ingest/ with the admission plane (ISSUE 7) —
-# the whole admission + proving + serving + instrumentation path sits
+# admission-plane, and proving-plane code.  crypto/ and zk/ were
+# promoted from informational with the analyzer work; obs/ joined with
+# the telemetry subsystem (ISSUE 4); ingest/ with the admission plane
+# (ISSUE 7); prover/ with the async proving plane (ISSUE 10) — the
+# whole admission + proving + serving + instrumentation path sits
 # behind the same wall as the kernels.
-HARD_TREES="protocol_tpu/ops protocol_tpu/trust protocol_tpu/parallel protocol_tpu/node protocol_tpu/analysis protocol_tpu/obs protocol_tpu/crypto protocol_tpu/zk protocol_tpu/ingest"
+HARD_TREES="protocol_tpu/ops protocol_tpu/trust protocol_tpu/parallel protocol_tpu/node protocol_tpu/analysis protocol_tpu/obs protocol_tpu/crypto protocol_tpu/zk protocol_tpu/ingest protocol_tpu/prover"
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check .

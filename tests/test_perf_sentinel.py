@@ -79,6 +79,54 @@ class TestIngestSeries:
         assert any("sigs_per_s" in k for k in report["series"])
 
 
+class TestProverSeries:
+    def test_prover_storm_rounds_feed_the_gate(self, tmp_path):
+        """ISSUE 10: PROVER_r*.json is in the default globs, its
+        ``entries`` list is walked, and steady_state_epoch_seconds /
+        p99_proof_lag_ms gate upward, sustained_proofs_per_s
+        downward."""
+        for i, (steady, lag, pps) in enumerate(
+            [(6.0, 12000.0, 0.1), (12.0, 40000.0, 0.03)], start=1
+        ):
+            (tmp_path / f"PROVER_r{i:02d}.json").write_text(
+                json.dumps(
+                    {
+                        "n": i,
+                        "entries": [
+                            {
+                                "metric": "steady-state epoch with async plane",
+                                "value": steady,
+                                "unit": "seconds",
+                                "steady_state_epoch_seconds": steady,
+                            },
+                            {
+                                "metric": "proving-plane proof latency",
+                                "p99_proof_lag_ms": lag,
+                                "sustained_proofs_per_s": pps,
+                            },
+                        ],
+                    }
+                )
+            )
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1  # r02 regressed all three directions vs r01
+        report = json.loads(out.read_text())
+        assert {
+            "proving-plane proof latency :: p99_proof_lag_ms",
+            "proving-plane proof latency :: sustained_proofs_per_s",
+            "steady-state epoch with async plane :: steady_state_epoch_seconds",
+        } <= set(report["regressions"])
+
+    def test_committed_prover_round_feeds_the_gate(self, tmp_path):
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(REPO), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert any("PROVER_r01.json" in f for f in report["history_files"])
+        assert any("p99_proof_lag_ms" in k for k in report["series"])
+
+
 class TestSyntheticRegression:
     def test_regressed_latest_round_fails(self, tmp_path):
         """Acceptance: exit non-zero on a synthetically regressed

@@ -2,8 +2,9 @@
 
 The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
 ``LADDER_r*.json``, since ISSUE 7 the ingest-storm rounds
-``INGEST_r*.json``, and since ISSUE 9 the multichip comm rounds
-``MULTICHIP_r*.json``) but nothing ever *read* the series — a PR could
+``INGEST_r*.json``, since ISSUE 9 the multichip comm rounds
+``MULTICHIP_r*.json``, and since ISSUE 10 the proving-plane rounds
+``PROVER_r*.json``) but nothing ever *read* the series — a PR could
 halve headline throughput and no gate would notice.  This tool closes
 the loop: it parses the recorded rounds into per-metric series
 (headline convergence seconds, cold/steady-state epoch seconds, plan
@@ -50,6 +51,10 @@ _FIELDS = {
     "sigs_per_s": False,
     "power_iters_per_sec": False,
     "p99_admission_ms": True,
+    # Proving-plane rounds (PROVER_r*.json): submit→proved tail latency
+    # and sustained proof throughput under the churned epoch replay.
+    "p99_proof_lag_ms": True,
+    "sustained_proofs_per_s": False,
     # Pass-8 comm scrape (MULTICHIP/LADDER rounds): per-iteration
     # collective wire volume of the sharded composites — a partitioner
     # surprise that inflates traffic regresses this series upward.
@@ -257,6 +262,7 @@ def main(argv: list[str] | None = None) -> int:
         "LADDER_r*.json",
         "INGEST_r*.json",
         "MULTICHIP_r*.json",
+        "PROVER_r*.json",
     ]
     paths = [
         Path(p) for pat in patterns for p in globlib.glob(str(root / pat))

@@ -1,0 +1,264 @@
+"""Multi-epoch async-proving dryrun (the CI prover-pipe step).
+
+Drives the real :class:`~protocol_tpu.node.pipeline.EpochPipeline` +
+:class:`~protocol_tpu.prover.plane.ProvingPlane` over a synthetic
+churned open graph for N epochs: every epoch's device stage ends at
+``converge`` and *enqueues* the fixed-set SNARK onto the proving
+plane's bounded queue — the ISSUE 10 acceptance shape —
+
+- every epoch tick's wall-clock excludes prove time (tick ≈ converge;
+  the overlap ratio tick/(tick+prove) stays below the bound),
+- proof lag stays bounded while the run is in flight and returns to 0
+  after the drain,
+- zero failed jobs, and every submitted epoch terminates explicitly:
+  ``proved`` or ``superseded`` (never a silent drop) with the newest
+  epoch always proved,
+- pooled proofs verify and carry the worker-side span attribution
+  (``prove{power_iterate, circuit_check, snark{msm, ...}}``) grafted
+  into the epoch's stored trace,
+
+and writes ``PROVER_PIPE.json`` with the per-epoch numbers.
+
+Run: ``JAX_PLATFORMS=cpu python tools/prover_pipe.py [--out FILE]
+[--prover plonk|commitment] [--workers N]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def _make_manager(graph, prover: str):
+    from protocol_tpu.node.manager import Manager, ManagerConfig
+    from protocol_tpu.trust.graph import TrustGraph
+
+    class _Mgr(Manager):
+        """Manager facade over a synthetic open graph (the epoch_pipe
+        pattern): peer "hashes" are row ids so warm-start and delta
+        plumbing run exactly as in production, while the fixed-set
+        proof path runs the real statement end to end."""
+
+        def __init__(self, g):
+            super().__init__(
+                ManagerConfig(
+                    backend="tpu-windowed",
+                    prover=prover,
+                    plan_delta_max_churn=0.25,
+                )
+            )
+            self._graph = g
+            self._rng = np.random.default_rng(23)
+
+        def churn(self, fraction: float) -> int:
+            """Sender-centric, recency-biased re-attestation (the
+            bench.py replay pattern, PERF.md §11): a cohort of
+            id-local peers rewrites its whole out-row — the churn
+            shape the delta plan's quantized capacity holds device
+            shapes stable under (whole-graph random edge rewires
+            instead touch most windows and force rebuild/recompile)."""
+            g = self._graph
+            avg_deg = max(g.nnz / g.n, 1.0)
+            cohort = max(1, int(round(fraction * g.nnz / avg_deg)))
+            offs = self._rng.exponential(
+                scale=max(g.n * 0.02, cohort), size=cohort
+            ).astype(np.int64)
+            rows = np.unique(g.n - 1 - np.minimum(offs, g.n - 1))
+            keep = ~np.isin(g.src, rows.astype(np.int32))
+            deg = max(1, int(round(avg_deg)))
+            ns = np.repeat(rows.astype(np.int32), deg)
+            nd = self._rng.integers(0, g.n, ns.shape[0]).astype(np.int32)
+            while (bad := nd == ns).any():
+                nd[bad] = self._rng.integers(0, g.n, int(bad.sum()))
+            nw = self._rng.integers(1, 1000, ns.shape[0]).astype(np.float32)
+            self._graph = TrustGraph(
+                g.n,
+                np.concatenate([g.src[keep], ns]),
+                np.concatenate([g.dst[keep], nd]),
+                np.concatenate([g.weight[keep], nw]),
+                g.pre_trusted,
+            )
+            self._dirty_hashes.update(int(r) for r in rows)
+            return int(ns.shape[0])
+
+        def build_graph(self):
+            self._id_order = list(range(self._graph.n))
+            return self._graph
+
+    return _Mgr(graph)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="PROVER_PIPE.json", help="report path")
+    ap.add_argument("--peers", type=int, default=20_000)
+    ap.add_argument("--edges", type=int, default=120_000)
+    ap.add_argument("--churn", type=float, default=0.01)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument(
+        "--prover",
+        default="plonk",
+        choices=("plonk", "commitment"),
+        help="proof backend for the enqueued jobs (plonk = the real "
+        "k=14 SNARK, the headline overlap; commitment = fast smoke)",
+    )
+    ap.add_argument(
+        "--workers",
+        type=int,
+        default=1,
+        help="prover worker processes (0 = prove inline on the plane's "
+        "dispatcher thread)",
+    )
+    ap.add_argument(
+        "--max-overlap-ratio",
+        type=float,
+        default=0.7,
+        help="fail if median tick/(tick+prove) exceeds this (the "
+        "'epoch excludes prove' acceptance bound)",
+    )
+    args = ap.parse_args(argv)
+
+    from protocol_tpu.models.graphs import scale_free
+    from protocol_tpu.node.epoch import Epoch
+    from protocol_tpu.node.pipeline import EpochPipeline
+    from protocol_tpu.obs import TRACER
+    from protocol_tpu.obs.metrics import PROOF_LAG_EPOCHS
+    from protocol_tpu.prover import ProvingPlane, ProvingPlaneConfig
+
+    manager = _make_manager(scale_free(args.peers, args.edges, seed=7), args.prover)
+    manager.generate_initial_attestations()
+    print(f"prover_pipe: warming {args.prover} prover (keygen/key cache)...")
+    manager.warm_prover()
+
+    plane = ProvingPlane(
+        ProvingPlaneConfig(workers=args.workers, queue_depth=2),
+        on_proved=lambda r: manager.install_proof(r.epoch, r.pub_ins, r.proof),
+    ).start()
+    cfg = manager.config
+    plane.prewarm(
+        (cfg.num_neighbours, cfg.num_iter, cfg.initial_score, cfg.scale),
+        cfg.prover,
+        cfg.srs_path,
+    )
+
+    def device_stage(prepared):
+        # The node's stage shape: converge, then enqueue the SNARK at
+        # tick end (microseconds) — the tick never waits on a prover,
+        # and the prove's CPU burst lands in the inter-tick gap rather
+        # than time-slicing against this tick's converge.
+        with TRACER.epoch(prepared.epoch.number):
+            result = manager.converge_prepared(prepared, alpha=0.1, max_iter=80)
+            plane.submit(manager.build_proof_job(prepared.epoch))
+            return result
+
+    per_epoch = []
+    lag_samples = []
+    bound = args.workers + 2 + 1  # queue_depth + workers + the in-flight tick
+    with EpochPipeline(manager, device_stage=device_stage) as pipe:
+        for k in range(args.epochs):
+            if k:
+                manager.churn(args.churn)
+            t0 = time.perf_counter()
+            pipe.submit(Epoch(k))
+            assert pipe.drain(timeout=600), f"epoch {k} did not finish"
+            tick = time.perf_counter() - t0
+            outcome = pipe.outcomes[k]
+            assert outcome.error is None, f"epoch {k}: {outcome.error!r}"
+            lag = PROOF_LAG_EPOCHS.value()
+            lag_samples.append(lag)
+            assert lag <= bound, f"proof lag {lag} epochs exceeds bound {bound}"
+            per_epoch.append(
+                {
+                    "epoch": k,
+                    "tick_seconds": round(tick, 4),
+                    "iterations": int(outcome.result.iterations),
+                    "proof_lag_epochs_after_tick": lag,
+                }
+            )
+    assert plane.drain(timeout=900), "proving plane did not drain"
+    stats = plane.stats()
+    plane.close()
+
+    # -- acceptance shape ----------------------------------------------
+    assert stats["failed"] == 0, f"failed proof jobs: {stats}"
+    for k in range(args.epochs):
+        state = stats["states"].get(k, {}).get("state")
+        assert state in ("proved", "superseded"), (
+            f"epoch {k} ended in {state!r} — every epoch must terminate "
+            "explicitly as proved or superseded"
+        )
+    newest = args.epochs - 1
+    assert stats["states"][newest]["state"] == "proved", (
+        "the newest epoch must always prove (latest-wins coalescing)"
+    )
+    assert PROOF_LAG_EPOCHS.value() == 0, "lag must return to 0 after drain"
+
+    prove_seconds = [
+        s["prove_seconds"]
+        for s in stats["states"].values()
+        if s.get("prove_seconds") is not None
+    ]
+    med_tick = statistics.median(e["tick_seconds"] for e in per_epoch)
+    med_prove = statistics.median(prove_seconds)
+    overlap_ratio = med_tick / max(med_tick + med_prove, 1e-9)
+    assert overlap_ratio <= args.max_overlap_ratio, (
+        f"median epoch tick {med_tick:.2f}s vs prove {med_prove:.2f}s: "
+        f"overlap ratio {overlap_ratio:.2f} exceeds "
+        f"{args.max_overlap_ratio} — prove is not off the epoch path"
+    )
+
+    # The grafted attribution must be visible on the stored traces of
+    # every proved epoch (it lands when the proof lands).
+    grafted = 0
+    for k in range(args.epochs):
+        trace = TRACER.get_trace(k)
+        if trace is None or stats["states"][k]["state"] != "proved":
+            continue
+        names = [c["name"] for c in trace["children"]]
+        assert "prove" in names, f"epoch {k}: no grafted prove span ({names})"
+        prove_span = next(c for c in trace["children"] if c["name"] == "prove")
+        child_names = [c["name"] for c in prove_span["children"]]
+        assert "snark" in child_names, child_names
+        grafted += 1
+    assert grafted >= 1, "no epoch trace carries the grafted prove tree"
+
+    report = {
+        "peers": args.peers,
+        "edges": args.edges,
+        "churn": args.churn,
+        "epochs": args.epochs,
+        "prover": args.prover,
+        "workers": args.workers,
+        "median_tick_seconds": round(med_tick, 4),
+        "median_prove_seconds": round(med_prove, 4),
+        "sync_epoch_estimate_seconds": round(med_tick + med_prove, 4),
+        "overlap_ratio": round(overlap_ratio, 4),
+        "proofs_completed": stats["completed"],
+        "proofs_failed": stats["failed"],
+        "proofs_superseded": stats["superseded"],
+        "max_proof_lag_epochs": max(lag_samples),
+        "grafted_traces": grafted,
+        "per_epoch": per_epoch,
+        "proof_states": {str(k): v for k, v in stats["states"].items()},
+    }
+    Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(
+        f"prover_pipe: OK — median tick {med_tick:.2f}s with prove "
+        f"{med_prove:.2f}s overlapped (ratio {overlap_ratio:.2f}), "
+        f"{stats['completed']} proved / {stats['superseded']} superseded / "
+        f"0 failed; report at {args.out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
