@@ -77,6 +77,19 @@ Pass 6 — the admission-plane boundary rule (ISSUE 7):
   epoch path would re-couple the convergence cadence to ingest load
   — exactly the contention the admission tier exists to remove.
 
+Pass 10 — the queue-observability rule (ISSUE 11):
+
+- ``unobserved-queue`` (error): a bounded ``queue.Queue(maxsize=...)``
+  constructed in a file with no queue-depth gauge write (a
+  ``*QUEUE_DEPTH*.set(...)`` call, or a gauge registration whose
+  metric name contains ``queue_depth``).  Every bounded queue is a
+  backpressure point: when it fills, something sheds, coalesces, or
+  blocks — and if its depth is not a first-class gauge, "the tier is
+  saturated" degrades from a scrape to a guess.  The rule is
+  file-scoped (the depth write lives next to the queue it observes);
+  rings (``deque(maxlen=...)``) are excluded — they overwrite, never
+  exert backpressure.
+
 Pass 9 — the proving-plane boundary rule (ISSUE 10):
 
 - ``blocking-prove-in-epoch-loop`` (error): a synchronous prover
@@ -317,6 +330,56 @@ def _is_unbounded_put(node: ast.Call, name: str | None) -> bool:
     return True
 
 
+#: Bounded-queue constructors the unobserved-queue rule tracks
+#: (pass 10).  Rings (deque(maxlen=...)) are excluded by design: they
+#: overwrite instead of backing pressure up, so depth is not a
+#: saturation signal there.
+_QUEUE_CONSTRUCTORS = frozenset({"queue.Queue", "Queue", "queue.PriorityQueue", "queue.LifoQueue"})
+
+
+def _is_bounded_queue_ctor(node: ast.Call, name: str | None) -> bool:
+    """``queue.Queue(maxsize=N)`` (or positional) with a bound that is
+    not literally 0/None — an unbounded queue has no depth-saturation
+    semantics to observe."""
+    if name not in _QUEUE_CONSTRUCTORS:
+        return False
+    bound: ast.expr | None = None
+    if node.args:
+        bound = node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "maxsize":
+            bound = kw.value
+    if bound is None:
+        return False
+    if isinstance(bound, ast.Constant) and not bound.value:
+        return False  # maxsize=0/None = unbounded
+    if (
+        isinstance(bound, ast.UnaryOp)
+        and isinstance(bound.operand, ast.Constant)
+    ):
+        return False  # maxsize=-1 = unbounded
+    return True
+
+
+def _is_depth_gauge_write(node: ast.Call, name: str | None) -> bool:
+    """A queue-depth observation: ``<...QUEUE_DEPTH...>.set(...)`` on
+    the metric registry, or a ``.gauge("...queue_depth...")``
+    registration."""
+    if name is None:
+        return False
+    receiver, _, leaf = name.rpartition(".")
+    if leaf == "set" and "queue_depth" in receiver.lower():
+        return True
+    if leaf == "gauge" and node.args:
+        first = node.args[0]
+        return (
+            isinstance(first, ast.Constant)
+            and isinstance(first.value, str)
+            and "queue_depth" in first.value.lower()
+        )
+    return False
+
+
 def _is_span_call(name: str | None) -> bool:
     """obs span entry points (``TRACER.span``/``TRACER.epoch`` or any
     ``*.span(...)``) — host boundaries by definition, so inside a
@@ -344,6 +407,11 @@ class _Visitor(ast.NodeVisitor):
         self.traced_depth = 0
         self.fn_depth = 0
         self.findings: list[Finding] = []
+        #: Pass-10 file-level state: bounded-queue construction sites
+        #: vs whether any queue-depth gauge write exists in this file
+        #: (resolved after the walk, in scan_source).
+        self.bounded_queue_sites: list[ast.AST] = []
+        self.has_depth_gauge = False
 
     def _emit(self, rule: str, message: str, node: ast.AST) -> None:
         self.findings.append(
@@ -379,6 +447,12 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
+        # Pass 10 bookkeeping: bounded-queue constructions vs depth-
+        # gauge writes, resolved per-file after the walk.
+        if _is_bounded_queue_ctor(node, name):
+            self.bounded_queue_sites.append(node)
+        elif _is_depth_gauge_write(node, name):
+            self.has_depth_gauge = True
         if self.jit_depth > 0:
             if name is not None:
                 root = name.split(".", 1)[0]
@@ -583,6 +657,20 @@ def scan_source(source: str, rel_path: str) -> list[Finding]:
         epoch_loop=rel_path in EPOCH_LOOP_FILES,
     )
     visitor.visit(tree)
+    if visitor.bounded_queue_sites and not visitor.has_depth_gauge:
+        # Pass 10: every bounded queue is a backpressure point; its
+        # depth must be a registered gauge in the same file, or
+        # saturation is a guess instead of a scrape.
+        for site in visitor.bounded_queue_sites:
+            visitor._emit(
+                "unobserved-queue",
+                "bounded queue constructed with no queue-depth gauge "
+                "write in this file — register a "
+                "*_queue_depth gauge (obs/metrics.py) and .set() it "
+                "where the queue's depth changes, so backpressure is "
+                "scrapeable",
+                site,
+            )
     return visitor.findings
 
 

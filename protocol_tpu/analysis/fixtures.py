@@ -252,7 +252,15 @@ def _journal_write_in_jit() -> tuple[str, str]:
 _BLOCKING_INGEST_SRC = '''\
 import queue
 
+from protocol_tpu.obs import metrics as obs_metrics
+
 PENDING = queue.Queue(maxsize=4)
+
+
+def observe_depth():
+    # Keeps this fixture single-purpose: pass 10's unobserved-queue
+    # rule is satisfied so only the pass-6 rules below fire.
+    obs_metrics.PIPELINE_QUEUE_DEPTH.set(PENDING.qsize())
 
 
 def device_stage(manager, atts, prepared):
@@ -288,6 +296,26 @@ def _blocking_prove_in_epoch_loop() -> tuple[str, str]:
     # epoch-loop file so the pass-9 rule applies exactly as it would
     # to the real module.
     return _BLOCKING_PROVE_SRC, "protocol_tpu/node/pipeline.py"
+
+
+_UNOBSERVED_QUEUE_SRC = '''\
+import queue
+
+
+class Stage:
+    def __init__(self):
+        # A bounded queue is a backpressure point; without a depth
+        # gauge in this file, "the stage is saturated" is a guess
+        # instead of a scrape.
+        self._queue = queue.Queue(maxsize=8)  # VIOLATION: unobserved-queue
+
+    def push(self, item):
+        self._queue.put_nowait(item)
+'''
+
+
+def _unobserved_queue() -> tuple[str, str]:
+    return _UNOBSERVED_QUEUE_SRC, "protocol_tpu/ingest/_fixture_unobserved_queue.py"
 
 
 #: Pass-7 seeded violations (whole-program concurrency rules).  Each
@@ -677,6 +705,10 @@ FIXTURES: dict[str, Fixture] = {
             "blocking-prove-in-epoch-loop", "blocking-prove-in-epoch-loop",
             _blocking_prove_in_epoch_loop, "blocking-prove-in-epoch-loop",
             kind="ast",
+        ),
+        Fixture(
+            "unobserved-queue", "unobserved-queue",
+            _unobserved_queue, "unobserved-queue", kind="ast",
         ),
         Fixture(
             "unguarded-shared-attr", "unguarded-shared-attr",

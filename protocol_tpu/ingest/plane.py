@@ -44,6 +44,7 @@ from ..crypto import group_pks_hash
 from ..obs import TRACER
 from ..obs import metrics as obs_metrics
 from ..obs.journal import JOURNAL
+from ..obs.lineage import LINEAGE
 from .dedup import ShardedDedupCache
 from .ratelimit import AdmissionPolicy, RateLimitConfig
 from .workers import VerifyCrashed, VerifyPool
@@ -86,6 +87,8 @@ class _Envelope:
     nonce: int | None
     enqueued: float
     future: Future
+    #: Lineage ID (obs/lineage.py) — 0 for the unsampled majority.
+    lineage: int = 0
 
 
 class IngestPlane:
@@ -209,6 +212,10 @@ class IngestPlane:
             nonce=nonce,
             enqueued=time.perf_counter(),
             future=Future(),
+            # Lineage sampling (obs/lineage.py): the unsampled path is
+            # one counter tick; a sampled envelope carries its flat int
+            # ID through every admission hop.
+            lineage=LINEAGE.maybe_begin(),
         )
         with self._cv:
             self._pending += 1
@@ -251,6 +258,7 @@ class IngestPlane:
                 if reason is not None:
                     self._resolve(env, False, reason)
                 else:
+                    LINEAGE.mark(env.lineage, "admitted")
                     batch.append(env)
             if batch and (len(batch) >= self.config.batch_size or env is None):
                 self._enqueue_batch(batch)
@@ -301,7 +309,19 @@ class IngestPlane:
             try:
                 with TRACER.span("ingest", batch=len(batch)):
                     verdicts = self.pool.verify(self._pks_hash, items)
-            except VerifyCrashed:
+            except VerifyCrashed as exc:
+                # The recovered worker flight tail ships with the
+                # crashed verdict: the post-mortem survives the
+                # process boundary (ISSUE 11 satellite).
+                JOURNAL.record(
+                    "anomaly",
+                    what="verify-batch-crashed",
+                    batch=len(batch),
+                    worker_flight_events=len(exc.flight_tail),
+                    worker_flight_last=(
+                        exc.flight_tail[-1] if exc.flight_tail else None
+                    ),
+                )
                 for env in batch:
                     self._resolve(env, False, "verify-crashed")
                 continue
@@ -316,7 +336,9 @@ class IngestPlane:
             obs_metrics.INGEST_VERIFY_BATCHES.inc(outcome="ok")
             for env, ok in zip(batch, verdicts):
                 if ok:
+                    LINEAGE.mark(env.lineage, "verified")
                     self.manager.apply_verified(env.att)
+                    LINEAGE.mark(env.lineage, "applied")
                     self._resolve(env, True, None)
                 else:
                     self._resolve(env, False, "bad-signature")
@@ -331,6 +353,10 @@ class IngestPlane:
         if accepted:
             self.policy.record_outcome(env.sender, True)
         else:
+            # A rejected attestation's lineage ends here: it will never
+            # be in an epoch, so its entry must not wait for one.
+            LINEAGE.drop(env.lineage, reason="rejected")
+        if not accepted:
             obs_metrics.ATTESTATIONS_REJECTED.inc(reason=why)
             JOURNAL.record("ingest-reject", reason=why)
             # The policy already tallied its own verdicts; sheds are

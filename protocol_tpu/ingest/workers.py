@@ -20,12 +20,18 @@ batch out to be *rejected with a reason code*, never silently dropped.
 from __future__ import annotations
 
 import os
+import tempfile
 import threading
+import time
 from concurrent.futures import BrokenExecutor, ProcessPoolExecutor
 from multiprocessing import get_context
 
 from ..obs import metrics as obs_metrics
-from ..obs.journal import JOURNAL
+from ..obs.journal import (
+    JOURNAL,
+    collect_worker_dumps,
+    install_worker_dump_handler,
+)
 
 #: Chaos hook for crash-recovery tests and the ingest-storm bench's
 #: worker-crash mix: a work item equal to this string hard-kills the
@@ -37,11 +43,14 @@ CRASH_MARKER = "__crash-worker__"
 WorkItem = tuple[int, int, int, int, int, tuple[int, ...]]
 
 
-def _worker_init() -> None:
+def _worker_init(dump_dir: str | None = None) -> None:
     """Runs in each spawned worker before any batch: pin the native
-    runtime to one OpenMP thread so the pool scales by process, and
-    pre-load the crypto tree off the critical path."""
+    runtime to one OpenMP thread so the pool scales by process,
+    pre-load the crypto tree off the critical path, and install the
+    flight-recorder dump handler so a SIGTERM'd worker leaves its
+    event ring behind for the parent's post-mortem."""
     os.environ["OMP_NUM_THREADS"] = "1"
+    install_worker_dump_handler(dump_dir, pool="ingest-verify")
     from ..crypto import native as cnative
 
     cnative.available()
@@ -79,9 +88,33 @@ def verify_batch(pks_hash: int, items: list) -> list[bool]:
     ]
 
 
+def verify_batch_shipping(pks_hash: int, items: list) -> tuple[list, dict]:
+    """Worker-process entry: verify the batch AND ship this process's
+    registry snapshot back with the verdicts — the cross-process
+    metric-aggregation hop.  The worker records its own sig-verify
+    metrics (its registry is process-private), journals the batch into
+    its flight ring, and the parent folds the snapshot into the fleet
+    aggregator under a ``process`` label."""
+    from ..obs.fleet import registry_snapshot
+
+    t0 = time.perf_counter()
+    verdicts = verify_batch(pks_hash, items)
+    obs_metrics.SIG_VERIFY_SECONDS.observe(time.perf_counter() - t0)
+    obs_metrics.SIGS_VERIFIED.inc(len(verdicts))
+    JOURNAL.record("verify-batch", n=len(verdicts), ok=sum(map(bool, verdicts)))
+    return verdicts, registry_snapshot(source=f"ingest-verify-{os.getpid()}")
+
+
 class VerifyCrashed(RuntimeError):
     """A batch's worker died ``max_retries + 1`` times; the caller must
-    reject the batch's items with a distinct reason code."""
+    reject the batch's items with a distinct reason code.
+    ``flight_tail`` carries whatever per-worker flight-recorder dumps
+    the pool recovered from the crash (SIGTERM'd workers dump their
+    ring; hard-killed ones leave nothing)."""
+
+    def __init__(self, *args: object) -> None:
+        super().__init__(*args)
+        self.flight_tail: list = []
 
 
 class VerifyPool:
@@ -100,6 +133,14 @@ class VerifyPool:
         self._lock = threading.Lock()
         self._generation = 0
         self._executor: ProcessPoolExecutor | None = None
+        #: Flight-recorder tails recovered from crashed workers' dump
+        #: files, attached to the next VerifyCrashed (under _lock).
+        self._flight_tail: list = []
+        self._dump_dir: str | None = (
+            tempfile.mkdtemp(prefix="ingest_verify_flight_")
+            if self.workers > 0
+            else None
+        )
         if self.workers > 0:
             self._executor = self._make()
 
@@ -108,6 +149,7 @@ class VerifyPool:
             max_workers=self.workers,
             mp_context=get_context("spawn"),
             initializer=_worker_init,
+            initargs=(self._dump_dir,),
         )
 
     def _snapshot(self) -> tuple[int, ProcessPoolExecutor | None]:
@@ -117,7 +159,10 @@ class VerifyPool:
     def _restart(self, generation: int) -> None:
         """Rebuild the executor once per crash: concurrent batches that
         all observed the same broken generation race here, and only the
-        first replaces it."""
+        first replaces it.  Any flight-recorder dumps the dead workers
+        left behind are journaled and kept for the next
+        :class:`VerifyCrashed` so the post-mortem survives the process
+        boundary."""
         with self._lock:
             if self._generation != generation or self._executor is None:
                 return
@@ -125,19 +170,40 @@ class VerifyPool:
             self._executor = self._make()
             self._generation += 1
         old.shutdown(wait=False, cancel_futures=True)
+        tails = collect_worker_dumps(self._dump_dir, pool="ingest-verify")
+        if tails:
+            with self._lock:
+                self._flight_tail.extend(tails)
         obs_metrics.INGEST_WORKER_RESTARTS.inc()
         JOURNAL.record("anomaly", what="ingest-worker-crashed", generation=generation)
+
+    def take_flight_tail(self) -> list:
+        """Pop the recovered worker flight-recorder events (attached to
+        crashed results by :meth:`verify`)."""
+        with self._lock:
+            tail, self._flight_tail = self._flight_tail, []
+        return tail
 
     def verify(self, pks_hash: int, items: list) -> list[bool]:
         """Blocking batch verdict with crash retry; raises
         :class:`VerifyCrashed` when the batch outlives its retries."""
+        from ..obs.fleet import FLEET
+
         attempts = 0
         while True:
             generation, executor = self._snapshot()
             try:
                 if executor is None:
                     return verify_batch(pks_hash, items)
-                return executor.submit(verify_batch, pks_hash, items).result()
+                verdicts, snap = executor.submit(
+                    verify_batch_shipping, pks_hash, items
+                ).result()
+                # The worker's registry rides back with the verdicts;
+                # latest-snapshot-per-source, so cumulative counters
+                # never double-count.
+                FLEET.ingest(snap.get("source", "ingest-verify"), snap)
+                obs_metrics.WORKER_SNAPSHOT_MERGES.inc(pool="ingest-verify")
+                return verdicts
             except (BrokenExecutor, RuntimeError) as exc:
                 # RuntimeError covers submit() on a shutdown executor
                 # racing close(); treat it like a crash for retry
@@ -146,9 +212,11 @@ class VerifyPool:
                 attempts += 1
                 if attempts > self.max_retries:
                     obs_metrics.INGEST_VERIFY_BATCHES.inc(outcome="failed")
-                    raise VerifyCrashed(
+                    crashed = VerifyCrashed(
                         f"verify batch of {len(items)} died {attempts} time(s)"
-                    ) from exc
+                    )
+                    crashed.flight_tail = self.take_flight_tail()
+                    raise crashed from exc
                 obs_metrics.INGEST_VERIFY_BATCHES.inc(outcome="retried")
 
     def close(self) -> None:
@@ -158,4 +226,11 @@ class VerifyPool:
             executor.shutdown(wait=False, cancel_futures=True)
 
 
-__all__ = ["CRASH_MARKER", "VerifyCrashed", "VerifyPool", "WorkItem", "verify_batch"]
+__all__ = [
+    "CRASH_MARKER",
+    "VerifyCrashed",
+    "VerifyPool",
+    "WorkItem",
+    "verify_batch",
+    "verify_batch_shipping",
+]

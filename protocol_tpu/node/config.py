@@ -101,6 +101,24 @@ class ProtocolConfig:
     #: node dumps the ring next to this path (or to
     #: ``FLIGHT_dump.jsonl`` in the working directory).
     journal_path: str | None = None
+    #: Attestation lineage sampling period (obs/lineage.py): one in N
+    #: accepted submissions carries a lineage ID through
+    #: intake → ... → proof-landed, feeding the per-stage
+    #: eigentrust_freshness_seconds histograms.  0 disables sampling;
+    #: the unsampled path costs one counter tick either way.
+    lineage_sample_every: int = 32
+    #: Shared directory for multi-process (jax.distributed) metric
+    #: exchange: each process publishes its registry snapshot here and
+    #: GET /metrics/fleet merges every sibling into one
+    #: process-labeled exposition.  None = single-process fleet (spawn
+    #: workers still merge through their result payloads).
+    fleet_dir: str | None = None
+    #: SLO targets (obs/slo.py): end-to-end freshness p99 and
+    #: submit-to-proved p99, in seconds.  The epoch-cadence objective
+    #: derives from epoch_interval; a violating objective flips
+    #: GET /slo to ok=false and fails the CI dryrun.
+    slo_freshness_p99_s: float = 120.0
+    slo_proof_lag_p99_s: float = 60.0
 
     @property
     def host(self) -> str:
@@ -154,6 +172,16 @@ class ProtocolConfig:
         cfg.srs_path = obj.get("srs_path", cfg.srs_path)
         cfg.profile_dir = obj.get("profile_dir", cfg.profile_dir)
         cfg.journal_path = obj.get("journal_path", cfg.journal_path)
+        cfg.lineage_sample_every = int(
+            obj.get("lineage_sample_every", cfg.lineage_sample_every)
+        )
+        cfg.fleet_dir = obj.get("fleet_dir", cfg.fleet_dir)
+        cfg.slo_freshness_p99_s = float(
+            obj.get("slo_freshness_p99_s", cfg.slo_freshness_p99_s)
+        )
+        cfg.slo_proof_lag_p99_s = float(
+            obj.get("slo_proof_lag_p99_s", cfg.slo_proof_lag_p99_s)
+        )
         return cfg
 
     @classmethod

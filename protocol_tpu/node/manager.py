@@ -27,6 +27,8 @@ from ..crypto.eddsa import PublicKey, sign, verify as verify_sig
 from ..obs import TRACER
 from ..obs import metrics as obs_metrics
 from ..obs.journal import JOURNAL
+from ..obs.lineage import LINEAGE
+from ..obs.timeline import TIMELINE
 from ..obs.watchers import DRIFT, RECOMPILES
 from ..ops.gather_window import WindowPlan
 from ..trust.backend import ConvergenceResult, get_backend
@@ -398,6 +400,11 @@ class Manager:
         raw_fp = getattr(plan, "fingerprint", 0) or 0
         fingerprint = int(raw_fp, 16) if isinstance(raw_fp, str) else int(raw_fp)
         return ProofJob(
+            # Flat lineage IDs for the spawn boundary: the epoch's
+            # sampled cohort (and earlier cohorts this proof covers);
+            # () on the unsampled path.  Excluded from job_seed, so
+            # sampling never perturbs proof bytes.
+            lineage=LINEAGE.ids_for_epoch(epoch.number),
             epoch=epoch.number,
             ops=tuple(tuple(int(s) for s in a.scores) for a in atts),
             sigs=tuple(
@@ -467,6 +474,15 @@ class Manager:
         if __debug__:
             assert self.prover.verify(pub_ins, proof_bytes)
         self.cached_proofs[epoch] = Proof(pub_ins=pub_ins, proof=proof_bytes)
+        # Sequential-prove lineage completion: this tick's proof covers
+        # every cohort bound at or before this epoch (the async plane
+        # does the same from its dispatcher when the proof lands).
+        e2e = LINEAGE.epoch_proved(epoch.number)
+        TIMELINE.record(
+            epoch.number,
+            proof={"state": "proved", "mode": "sync"},
+            freshness={"completed": len(e2e)},
+        )
 
     def _warm_t0(self, id_order: list[int]) -> np.ndarray | None:
         """Remap the previous epoch's fixed point onto the new graph's
@@ -537,6 +553,19 @@ class Manager:
         id_order = list(self._id_order)[: graph.n]
         obs_metrics.GRAPH_PEERS.set(graph.n)
         obs_metrics.GRAPH_EDGES.set(graph.nnz)
+        # This graph absorbed the attestation cache: every applied
+        # lineage entry is now included-in-epoch, and the timeline's
+        # ingest watermark records what the epoch saw.
+        included = LINEAGE.bind_epoch(epoch.number)
+        TIMELINE.record(
+            epoch.number,
+            ingest_watermark={
+                "accepted_total": obs_metrics.ATTESTATIONS_ACCEPTED.value(),
+                "attestations_cached": len(self.attestations),
+                "lineage_included": len(included),
+            },
+            graph={"peers": int(graph.n), "edges": int(graph.nnz)},
+        )
         t0 = self._warm_t0(id_order) if self.config.warm_start else None
         delta_rows = None
         if cached_plan is not None and dirty:
@@ -635,6 +664,19 @@ class Manager:
             prepared.id_order,
             result.scores,
             result.residuals,
+        )
+        # The epoch's lineage cohort has a converged (not yet proven)
+        # fixed point; the timeline gets the converge fragment.
+        LINEAGE.epoch_converged(prepared.epoch.number)
+        TIMELINE.record(
+            prepared.epoch.number,
+            converge={
+                "iterations": int(result.iterations),
+                "residual": float(result.residual),
+                "backend": str(result.backend),
+                "warm_start": prepared.t0 is not None,
+                "delta_plan": prepared.delta_rows is not None,
+            },
         )
         return result
 

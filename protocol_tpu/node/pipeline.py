@@ -35,6 +35,7 @@ from typing import Any, Callable
 
 from ..obs import metrics as obs_metrics
 from ..obs.journal import JOURNAL
+from ..obs.timeline import TIMELINE
 from ..trust.backend import ConvergenceResult
 from .epoch import Epoch
 from .manager import Manager, PreparedEpoch
@@ -162,6 +163,9 @@ class EpochPipeline:
             obs_metrics.PIPELINE_QUEUE_DEPTH.set(self._queue.qsize())
         if superseded is not None:
             obs_metrics.EPOCH_TICKS_COALESCED.inc()
+            TIMELINE.record(
+                superseded.epoch.number, coalesced_by=prepared.epoch.number
+            )
             JOURNAL.record(
                 "coalesced-tick",
                 superseded=superseded.epoch.number,

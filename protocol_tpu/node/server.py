@@ -21,7 +21,17 @@ from dataclasses import dataclass, field
 
 import json
 
-from ..obs import DRIFT, JOURNAL, TRACER, configure_logging, prometheus_text
+from ..obs import (
+    DRIFT,
+    JOURNAL,
+    LINEAGE,
+    SLO_ENGINE,
+    TIMELINE,
+    TRACER,
+    configure_logging,
+    fleet_prometheus_text,
+    prometheus_text,
+)
 from ..obs import metrics as obs_metrics
 from ..obs.export import PROMETHEUS_CONTENT_TYPE, profile_session
 from ..utils.telemetry import TELEMETRY
@@ -37,6 +47,7 @@ BAD_REQUEST = 400
 NOT_FOUND = 404
 TOO_MANY_REQUESTS = 429
 INTERNAL_SERVER_ERROR = 500
+SERVICE_UNAVAILABLE = 503
 
 _STATUS_TEXT = {
     200: "OK",
@@ -44,6 +55,7 @@ _STATUS_TEXT = {
     404: "Not Found",
     429: "Too Many Requests",
     500: "Internal Server Error",
+    503: "Service Unavailable",
 }
 
 #: Largest accepted POST body (an attestation payload is a few KiB).
@@ -58,13 +70,106 @@ def _backend_tag(manager: Manager) -> str:
     return getattr(manager.prover, "wire_tag", "")
 
 
+#: /healthz verdicts, in severity order (the gauge value is the index).
+HEALTH_VERDICTS = ("ok", "degraded", "failed")
+
+
+def node_health(node: "Node | None") -> tuple[int, dict]:
+    """Aggregate component state into the load-balancer verdict:
+
+    - ``ok``      → 200: epochs ticking, planes up, SLOs green;
+    - ``degraded``→ 200: serving, but warming up (no epoch yet), an
+      SLO is violating, or a plane shows backpressure/failures —
+      readable by dashboards, still in rotation;
+    - ``failed``  → 503: the epoch loop stalled past 3 intervals, or a
+      configured plane never started — pull this node.
+
+    Works without a node (``handle_request`` in tests/tools): the
+    epoch-cadence and SLO components still evaluate from the
+    process-global timeline/engine; plane components report absent."""
+    problems: list[str] = []
+    degraded: list[str] = []
+    interval = float(node.config.epoch_interval) if node is not None else None
+    since = TIMELINE.seconds_since_last_tick()
+    latest = TIMELINE.latest_epoch()
+    epoch_comp: dict = {
+        "latest": latest,
+        "seconds_since_last_tick": round(since, 3) if since is not None else None,
+        "interval": interval,
+    }
+    if latest is None:
+        degraded.append("no-epoch-yet")
+    elif interval is not None and since is not None and since > 3.0 * interval:
+        problems.append("epoch-loop-stalled")
+    components: dict = {"epoch": epoch_comp}
+
+    slo = SLO_ENGINE.last()
+    components["slo"] = {
+        "ok": bool(slo.get("ok", True)),
+        "violating": sorted(
+            name
+            for name, o in slo.get("objectives", {}).items()
+            if not o.get("ok", True)
+        ),
+    }
+    if not components["slo"]["ok"]:
+        degraded.append("slo-violating")
+
+    if node is not None:
+        ingest = node._ingest
+        components["ingest"] = {
+            "configured": bool(node.config.ingest_plane),
+            "started": ingest is not None,
+            "pending": ingest.stats()["pending"] if ingest is not None else None,
+        }
+        if node.config.ingest_plane and ingest is None and node._server is not None:
+            problems.append("ingest-plane-not-started")
+        plane = node._prover_plane
+        if plane is not None:
+            stats = plane.stats()
+            components["prover"] = {
+                "configured": True,
+                "generation": plane.pool.generation,
+                "queue_depth": stats["queue_depth"],
+                "pending": stats["pending"],
+                "failed": stats["failed"],
+                "lag_epochs": obs_metrics.PROOF_LAG_EPOCHS.value(),
+            }
+            if stats["failed"] > 0:
+                degraded.append("proof-jobs-failed")
+        else:
+            components["prover"] = {"configured": bool(node.config.async_prover)}
+        components["pipeline"] = {
+            "configured": bool(node.config.epoch_pipeline),
+            "queue_depth": obs_metrics.PIPELINE_QUEUE_DEPTH.value(),
+        }
+
+    if problems:
+        verdict = "failed"
+    elif degraded:
+        verdict = "degraded"
+    else:
+        verdict = "ok"
+    obs_metrics.HEALTH_STATUS.set(HEALTH_VERDICTS.index(verdict))
+    status = SERVICE_UNAVAILABLE if verdict == "failed" else 200
+    return status, {
+        "status": verdict,
+        "problems": problems,
+        "degraded": degraded,
+        "components": components,
+    }
+
+
 def handle_request(
-    method: str, path: str, manager: Manager, plane=None
+    method: str, path: str, manager: Manager, plane=None, node=None
 ) -> tuple[int, str]:
     """Route one request (main.rs:85-119 + the rebuild's observability
     surface).  Returns (status, body).  ``plane`` is the node's async
     :class:`~protocol_tpu.prover.plane.ProvingPlane` (or None in
-    sequential-prove mode) — the ``/proof`` lifecycle source."""
+    sequential-prove mode) — the ``/proof`` lifecycle source; ``node``
+    is the owning :class:`Node` for the component-state surfaces
+    (``/healthz``, the fleet scrape's directory exchange) and may be
+    None for manager-only embedding."""
     if method == "GET" and path.startswith("/proof/"):
         # /proof/<epoch> (or /proof/latest): the proof itself when it
         # landed, else the job's lifecycle state (queued / proving /
@@ -148,6 +253,50 @@ def handle_request(
         # content type to text/plain for this path.  Never touches
         # device state — purely the host-side registry snapshot.
         return 200, prometheus_text()
+    if method == "GET" and path == "/metrics/fleet":
+        # The fleet-merged exposition: this process's registry plus
+        # every aggregated worker snapshot (and, with a configured
+        # fleet_dir, every sibling process in a jax.distributed run),
+        # each series stamped with a `process` label.
+        if node is not None and node.config.fleet_dir:
+            import os as _os
+
+            from ..obs.fleet import load_directory, publish_snapshot
+
+            publish_snapshot(node.config.fleet_dir, _os.getpid())
+            load_directory(node.config.fleet_dir, skip_pid=_os.getpid())
+        return 200, fleet_prometheus_text()
+    if method == "GET" and path == "/slo":
+        # Evaluate-on-scrape: the engine also evaluates at every epoch
+        # tick, so the burn windows advance with or without scrapers.
+        return 200, json.dumps(SLO_ENGINE.evaluate())
+    if method == "GET" and path == "/healthz":
+        status, body = node_health(node)
+        return status, json.dumps(body)
+    if method == "GET" and path.startswith("/timeline/"):
+        # /timeline/<epoch> (or /timeline/latest): the epoch's joined
+        # record — ingest watermarks, phase durations, converge stats,
+        # proof lifecycle, freshness summary — merged at write time by
+        # every subsystem that touched the epoch.
+        arg = path.removeprefix("/timeline/")
+        if arg == "latest":
+            latest = TIMELINE.latest_epoch()
+            if latest is None:
+                return NOT_FOUND, json.dumps({"error": "no epochs yet"})
+            arg = str(latest)
+        try:
+            epoch_number = int(arg)
+        except ValueError:
+            return BAD_REQUEST, "InvalidQuery"
+        record = TIMELINE.get(epoch_number)
+        if record is None:
+            return NOT_FOUND, json.dumps(
+                {
+                    "error": f"no timeline for epoch {epoch_number}",
+                    "epochs": TIMELINE.epochs(),
+                }
+            )
+        return 200, json.dumps(record)
     if method == "GET" and path == "/scores/drift":
         # Score-integrity surface (obs/watchers.py): L1/L∞ drift of
         # the last landed fixed point vs its predecessor, top movers,
@@ -274,15 +423,17 @@ class Node:
                         parts[1],
                         self.manager,
                         self._prover_plane,
+                        self,
                     )
                 else:
                     status, body = handle_request(
-                        parts[0], parts[1], self.manager, self._prover_plane
+                        parts[0], parts[1], self.manager, self._prover_plane, self
                     )
             payload = body.encode()
             content_type = (
                 PROMETHEUS_CONTENT_TYPE
-                if len(parts) >= 2 and parts[1].split("?", 1)[0] == "/metrics"
+                if len(parts) >= 2
+                and parts[1].split("?", 1)[0] in ("/metrics", "/metrics/fleet")
                 else "application/json"
             )
             writer.write(
@@ -355,6 +506,10 @@ class Node:
         epoch and nothing inside the jit'd loop."""
         with TRACER.epoch(epoch.number):
             if self._prover_plane is None:
+                # Sequential semantics prove the cache as of tick
+                # start — bind the lineage cohort now so this tick's
+                # proof completes exactly what it attests to.
+                LINEAGE.bind_epoch(epoch.number)
                 self._prove_or_enqueue(epoch)
             scores = None
             if self.manager.config.backend != "native-cpu":
@@ -389,6 +544,9 @@ class Node:
                 self._prove_or_enqueue(epoch)
         TELEMETRY.count("epochs")
         obs_metrics.EPOCHS_TOTAL.inc()
+        # Continuous SLO evaluation: every landed tick advances the
+        # burn windows (scrapes of GET /slo evaluate too).
+        SLO_ENGINE.evaluate()
         if self._ingest is not None:
             # Epoch-aligned dedup eviction: "recent" replays are those
             # inside the horizon that could still perturb convergence.
@@ -461,6 +619,7 @@ class Node:
         epoch = prepared.epoch
         with TRACER.epoch(epoch.number):
             if self._prover_plane is None:
+                LINEAGE.bind_epoch(epoch.number)
                 self._prove_or_enqueue(epoch)
             scores = None
             result = None
@@ -490,6 +649,7 @@ class Node:
                 self._prove_or_enqueue(epoch)
         TELEMETRY.count("epochs")
         obs_metrics.EPOCHS_TOTAL.inc()
+        SLO_ENGINE.evaluate()
         if self._ingest is not None:
             self._ingest.advance_epoch()
         return result
@@ -646,6 +806,17 @@ class Node:
     async def start(self) -> None:
         if self.config.journal_path:
             JOURNAL.configure(self.config.journal_path)
+        # Fleet-plane boot: lineage sampling period and the standing
+        # SLO objectives (cadence target derives from the configured
+        # epoch interval).
+        LINEAGE.configure(self.config.lineage_sample_every)
+        from ..obs.slo import install_defaults
+
+        install_defaults(
+            epoch_interval_s=self.config.epoch_interval,
+            freshness_p99_s=self.config.slo_freshness_p99_s,
+            proof_lag_p99_s=self.config.slo_proof_lag_p99_s,
+        )
         # SIGTERM post-mortem: dump the event ring before the process
         # dies, so "what was the node doing" survives an orchestrator
         # kill.  Best-effort — platforms without add_signal_handler

@@ -25,7 +25,23 @@ proving, checkpointing, and serving.  Five pieces:
 - :mod:`~protocol_tpu.obs.watchers` — runtime invariant watchers:
   jit recompile tracking around the converge entry points, per-span
   device-memory watermarks, and the score-integrity/drift monitor
-  behind ``GET /scores/drift``.
+  behind ``GET /scores/drift``;
+- :mod:`~protocol_tpu.obs.lineage` — attestation lineage sampling: a
+  configurable fraction of submissions carry a flat int lineage ID
+  through intake → admission → verify → apply → included-in-epoch →
+  converged → proof-landed, feeding the per-stage
+  ``eigentrust_freshness_seconds`` histograms (end-to-end freshness);
+- :mod:`~protocol_tpu.obs.timeline` — the epoch timeline registry:
+  one joined record per epoch (ingest watermarks, phase durations,
+  proof lifecycle, freshness) served as ``GET /timeline/<epoch>``;
+- :mod:`~protocol_tpu.obs.fleet` — cross-process metric aggregation:
+  worker registries shipped back across the spawn boundary and
+  multi-process snapshot exchange, merged into one ``process``-labeled
+  exposition at ``GET /metrics/fleet``;
+- :mod:`~protocol_tpu.obs.slo` — the declarative SLO engine behind
+  ``GET /slo``: objectives over the registry (freshness p99, proof-lag
+  p99, epoch cadence, shed rate, residual stalls) with burn-rate
+  state, journaled transitions, and CI enforcement.
 
 Doctrine (enforced by graftlint passes 3 and 5,
 ``analysis/ast_rules.py``): spans, metrics, and journal writes live
@@ -42,10 +58,16 @@ module costs nothing at import time.
 
 from __future__ import annotations
 
+import time as _time
+
 from . import metrics as _metrics
 from .export import metrics_json, profile_session, prometheus_text
+from .fleet import FLEET, FleetAggregator, fleet_prometheus_text, registry_snapshot
 from .journal import JOURNAL, FlightRecorder
+from .lineage import LINEAGE, LineageTracker
 from .metrics import METRICS, MetricsRegistry
+from .slo import SLO_ENGINE, SLOEngine, SLObjective
+from .timeline import TIMELINE, TimelineRegistry
 from .trace import (
     TRACER,
     Span,
@@ -71,6 +93,22 @@ def _span_closed(span: Span) -> None:
     # timings (plan, converge, prove, checkpoint, sig_verify, ...) are
     # scrapeable without separate timer plumbing at each site.
     _metrics.PHASE_SECONDS.observe(span.duration_s or 0.0, phase=span.name)
+    # An epoch root closing is the timeline's phase-join moment: the
+    # tick wall-clock and the per-phase durations land on the epoch's
+    # record in one write (children with repeated names last-win —
+    # the phases here mirror /trace exactly).
+    if span.name == "epoch_tick" and "epoch" in span.attrs:
+        TIMELINE.record(
+            span.attrs["epoch"],
+            tick_seconds=round(span.duration_s or 0.0, 6),
+            tick_ended_unix=round(_time.time(), 3),
+            phases={
+                c.name: round(c.duration_s or 0.0, 6)
+                for c in span.children
+                if c.duration_s is not None
+            },
+            error=bool(span.attrs.get("error", False)),
+        )
     # ... and the flight recorder, so a post-mortem replays the span
     # sequence without the trace ring having kept the epoch.
     fields = {"name": span.name, "duration_s": round(span.duration_s or 0.0, 6)}
@@ -87,21 +125,32 @@ TRACER.on_span_open = MEMORY_WATERMARKS.on_open
 
 __all__ = [
     "DRIFT",
+    "FLEET",
     "JOURNAL",
+    "LINEAGE",
     "METRICS",
     "MEMORY_WATERMARKS",
     "RECOMPILES",
+    "SLO_ENGINE",
+    "TIMELINE",
+    "FleetAggregator",
     "FlightRecorder",
+    "LineageTracker",
     "MemoryWatermarkWatcher",
     "MetricsRegistry",
     "RecompileTracker",
+    "SLOEngine",
+    "SLObjective",
     "ScoreDriftMonitor",
     "Span",
     "SpanContextFilter",
     "TRACER",
+    "TimelineRegistry",
     "Tracer",
     "configure_logging",
+    "fleet_prometheus_text",
     "metrics_json",
     "profile_session",
     "prometheus_text",
+    "registry_snapshot",
 ]

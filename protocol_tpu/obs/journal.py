@@ -236,4 +236,90 @@ class FlightRecorder:
 JOURNAL = FlightRecorder()
 
 
-__all__ = ["JOURNAL", "FlightRecorder"]
+# ---------------------------------------------------------------------------
+# Spawn-boundary post-mortems (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+#: Events kept per recovered worker dump when journaling its tail.
+_WORKER_TAIL_EVENTS = 20
+
+
+def install_worker_dump_handler(
+    dump_dir: str | os.PathLike | None, pool: str
+) -> None:
+    """Worker-bootstrap half: install a SIGTERM handler that dumps the
+    worker process's flight-recorder ring into ``dump_dir`` before the
+    process dies, so a terminated worker's last events survive the
+    spawn boundary (a hard ``os._exit`` kill leaves nothing — same as
+    a real SIGKILL).  No-op without a dump dir or where signals are
+    unavailable; never raises (this runs in every worker's init)."""
+    if not dump_dir:
+        return
+    try:
+        import signal
+
+        directory = Path(dump_dir)
+
+        def _dump(signum, frame):  # pragma: no cover - runs in workers
+            try:
+                JOURNAL.dump(
+                    directory / f"flight-{pool}-{os.getpid()}.jsonl",
+                    reason=f"{pool}-SIGTERM",
+                )
+            finally:
+                os._exit(143)
+
+        signal.signal(signal.SIGTERM, _dump)
+    except (ImportError, ValueError, OSError):
+        pass
+
+
+def collect_worker_dumps(
+    dump_dir: str | os.PathLike | None,
+    pool: str,
+    *,
+    tail_events: int = _WORKER_TAIL_EVENTS,
+) -> list[dict[str, Any]]:
+    """Parent half: read (then delete) every per-worker flight dump in
+    ``dump_dir``, journal each tail as a ``worker-flight-tail`` event,
+    and return the recovered events — the pools attach them to their
+    ``*-crashed`` results so a post-mortem sees what the worker was
+    doing when it died."""
+    if not dump_dir:
+        return []
+    recovered: list[dict[str, Any]] = []
+    directory = Path(dump_dir)
+    if not directory.is_dir():
+        return recovered
+    for path in sorted(directory.glob("flight-*.jsonl")):
+        try:
+            lines = path.read_text().splitlines()
+        except OSError:
+            continue
+        events: list[dict[str, Any]] = []
+        for line in lines[-(tail_events + 1) :]:
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        JOURNAL.record(
+            "worker-flight-tail",
+            pool=pool,
+            dump=path.name,
+            events=len(events),
+            last=events[-1] if events else None,
+        )
+        recovered.extend(events)
+        try:
+            path.unlink()
+        except OSError:
+            pass
+    return recovered
+
+
+__all__ = [
+    "JOURNAL",
+    "FlightRecorder",
+    "collect_worker_dumps",
+    "install_worker_dump_handler",
+]

@@ -166,6 +166,34 @@ class Histogram(Metric):
             state = self._states.get(key)
             return state.count if state is not None else 0
 
+    def quantile(self, q: float, **labels: Any) -> float | None:
+        """Estimate the q-quantile (0 < q <= 1) from the cumulative
+        buckets — linear interpolation inside the covering bucket, the
+        standard Prometheus ``histogram_quantile`` shape.  Returns None
+        with no observations; values past the last finite bound clamp
+        to it (the +Inf bucket has no interpolable width)."""
+        key = self._label_key(labels)
+        with self._lock:
+            state = self._states.get(key)
+            if state is None or state.count == 0:
+                return None
+            counts = list(state.bucket_counts)
+            total = state.count
+        rank = q * total
+        prev_bound, prev_count = 0.0, 0
+        for bound, cum in zip(self.bucket_bounds, counts):
+            if cum >= rank:
+                if bound == math.inf:
+                    # No width to interpolate over: the best estimate
+                    # is the largest finite bound.
+                    return self.bucket_bounds[-2]
+                width = cum - prev_count
+                if width <= 0:
+                    return bound
+                return prev_bound + (bound - prev_bound) * (rank - prev_count) / width
+            prev_bound, prev_count = bound, cum
+        return self.bucket_bounds[-2]
+
     def snapshot(self) -> dict[LabelValues, dict[str, Any]]:
         with self._lock:
             return {
@@ -257,6 +285,12 @@ TIME_BUCKETS = (
 #: Convergence residuals: log-spaced around typical tol values.
 RESIDUAL_BUCKETS = (
     1e-9, 1e-8, 1e-7, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0,
+)
+#: End-to-end freshness (attestation accepted -> proven servable
+#: score): sub-second ingest hops up through multi-epoch proof lag.
+FRESHNESS_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0,
+    30.0, 60.0, 120.0, 300.0, 600.0,
 )
 
 #: Process-global registry (the node's /metrics source).
@@ -458,6 +492,73 @@ PROVER_WORKER_RESTARTS = METRICS.counter(
     "Prover worker-pool rebuilds after a worker process died or hung "
     "past the per-job timeout",
 )
+FRESHNESS_SECONDS = METRICS.histogram(
+    "eigentrust_freshness_seconds",
+    "Elapsed wall-clock since intake for each lineage-sampled "
+    "attestation at every hop of its life (stage label: admitted, "
+    "verified, applied, included, converged, proof_landed) — "
+    "stage=proof_landed is the end-to-end freshness headline: how long "
+    "from POST /attestation to its effect in a proven, servable score",
+    labelnames=("stage",),
+    buckets=FRESHNESS_BUCKETS,
+)
+LINEAGE_SAMPLED = METRICS.counter(
+    "eigentrust_lineage_sampled_total",
+    "Attestations that drew a lineage ID at intake (the sampled "
+    "fraction; unsampled submissions pay zero tracker state)",
+)
+LINEAGE_COMPLETED = METRICS.counter(
+    "eigentrust_lineage_completed_total",
+    "Lineage-sampled attestations that reached proof_landed (their "
+    "including epoch's SNARK is served)",
+)
+LINEAGE_DROPPED = METRICS.counter(
+    "eigentrust_lineage_dropped_total",
+    "Lineage entries abandoned before proof_landed, by reason: "
+    "rejected (the attestation failed admission/verify), evicted "
+    "(tracker capacity), shutdown",
+    labelnames=("reason",),
+)
+PROOF_LAG_SECONDS = METRICS.histogram(
+    "eigentrust_proof_lag_seconds",
+    "Submit-to-proved wall-clock per proof job (the per-job component "
+    "of the proof-lag headline; the SLO engine gates its p99)",
+    buckets=FRESHNESS_BUCKETS,
+)
+SLO_OK = METRICS.gauge(
+    "eigentrust_slo_ok",
+    "Per-objective SLO verdict at the last evaluation: 1 = meeting "
+    "the objective (or no data yet), 0 = violating",
+    labelnames=("objective",),
+)
+SLO_BURN_RATE = METRICS.gauge(
+    "eigentrust_slo_burn_rate",
+    "Fraction of the objective's recent evaluation window spent in "
+    "violation (0 = healthy, 1 = burning the whole window)",
+    labelnames=("objective",),
+)
+SLO_VIOLATIONS = METRICS.counter(
+    "eigentrust_slo_violations_total",
+    "ok->violating transitions per objective (each one is journaled "
+    "with the violating value)",
+    labelnames=("objective",),
+)
+HEALTH_STATUS = METRICS.gauge(
+    "eigentrust_health_status",
+    "GET /healthz verdict as a number: 0 = ok, 1 = degraded, "
+    "2 = failed (load balancers read the HTTP status instead)",
+)
+FLEET_SOURCES = METRICS.gauge(
+    "eigentrust_fleet_sources",
+    "Worker/process metric snapshots currently merged into the fleet "
+    "scrape (GET /metrics/fleet), beyond the node process itself",
+)
+WORKER_SNAPSHOT_MERGES = METRICS.counter(
+    "eigentrust_worker_metric_merges_total",
+    "Per-worker metric snapshots shipped back across the spawn "
+    "boundary and merged into the fleet aggregator, by pool",
+    labelnames=("pool",),
+)
 LOCK_WAIT_SECONDS = METRICS.histogram(
     "eigentrust_lock_wait_seconds",
     "Lock-acquisition wait time by allocation site — recorded only "
@@ -474,6 +575,7 @@ __all__ = [
     "METRICS",
     "Metric",
     "MetricsRegistry",
+    "FRESHNESS_BUCKETS",
     "RESIDUAL_BUCKETS",
     "TIME_BUCKETS",
     "ATTESTATIONS_ACCEPTED",
@@ -515,5 +617,16 @@ __all__ = [
     "PROOFS_FAILED",
     "PROOFS_SUPERSEDED",
     "PROVER_WORKER_RESTARTS",
+    "FRESHNESS_SECONDS",
+    "LINEAGE_SAMPLED",
+    "LINEAGE_COMPLETED",
+    "LINEAGE_DROPPED",
+    "PROOF_LAG_SECONDS",
+    "SLO_OK",
+    "SLO_BURN_RATE",
+    "SLO_VIOLATIONS",
+    "HEALTH_STATUS",
+    "FLEET_SOURCES",
+    "WORKER_SNAPSHOT_MERGES",
     "LOCK_WAIT_SECONDS",
 ]

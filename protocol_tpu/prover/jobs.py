@@ -81,6 +81,12 @@ class ProofJob:
     graph_fingerprint: int = 0
     #: Chaos hook (tests/bench): CRASH_MARKER or crash_once_marker().
     chaos: str | None = None
+    #: Lineage IDs (obs/lineage.py) whose end-to-end freshness this
+    #: epoch's proof completes — flat ints across the spawn boundary,
+    #: echoed back on the :class:`ProofResult`.  ``()`` on the
+    #: unsampled path.  Bookkeeping only: excluded from
+    #: :func:`job_seed`, so sampling never perturbs proof bytes.
+    lineage: tuple[int, ...] = ()
 
 
 @dataclass
@@ -95,6 +101,13 @@ class ProofResult:
     #: — grafted into the epoch's stored trace by the plane.
     spans: dict[str, Any]
     prove_seconds: float
+    #: The job's lineage IDs, echoed back flat (spawn-boundary proof
+    #: that sampling survives the worker round-trip).
+    lineage: tuple[int, ...] = ()
+    #: The worker process's registry snapshot
+    #: (``obs.fleet.registry_snapshot``) — merged into the parent's
+    #: fleet aggregator under a ``process`` label.
+    metrics: dict[str, Any] | None = None
 
 
 def job_seed(job: ProofJob) -> bytes:
@@ -230,12 +243,20 @@ def prove_job(job: ProofJob, *, verify: bool = True) -> ProofResult:
         assert prover.verify(pub_ins, proof_bytes), (
             f"epoch {job.epoch}: freshly produced proof failed verification"
         )
+    from ..obs.fleet import registry_snapshot
+
+    # PROVE_SECONDS is observed by the plane when the result lands
+    # (once, whichever process proved); the worker's own registry ships
+    # its span-fed phase histograms in the snapshot below.
+    prove_seconds = time.perf_counter() - t0
     return ProofResult(
         epoch=job.epoch,
         pub_ins=tuple(pub_ins),
         proof=proof_bytes,
         spans=root.to_dict(),
-        prove_seconds=time.perf_counter() - t0,
+        prove_seconds=prove_seconds,
+        lineage=tuple(job.lineage),
+        metrics=registry_snapshot(source=f"prover-{os.getpid()}"),
     )
 
 

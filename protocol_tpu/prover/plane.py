@@ -34,6 +34,7 @@ ran epochs later in another process.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -42,7 +43,10 @@ from typing import Any, Callable
 
 from ..obs import TRACER
 from ..obs import metrics as obs_metrics
+from ..obs.fleet import FLEET
 from ..obs.journal import JOURNAL
+from ..obs.lineage import LINEAGE
+from ..obs.timeline import TIMELINE
 from .jobs import (
     FAILED,
     PROVED,
@@ -58,6 +62,14 @@ log = logging.getLogger(__name__)
 
 #: Terminal lifecycle entries kept for inspection (the /proof surface).
 _STATUS_RING = 64
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(int(round(q * (len(vals) - 1))), len(vals) - 1)
+    return vals[idx]
 
 
 @dataclass(frozen=True)
@@ -232,8 +244,20 @@ class ProvingPlane:
             self._update_lag_locked()
             obs_metrics.PROOF_QUEUE_DEPTH.set(len(self._queue))
             self._cv.notify()
+        TIMELINE.record(
+            job.epoch,
+            proof={
+                "state": QUEUED,
+                "submitted_unix": round(time.time(), 3),
+                "lineage_ids": len(job.lineage),
+            },
+        )
         if displaced is not None:
             obs_metrics.PROOFS_SUPERSEDED.inc()
+            TIMELINE.record(
+                displaced.epoch,
+                proof={"state": SUPERSEDED, "superseded_by": job.epoch},
+            )
             JOURNAL.record(
                 "proof-superseded", epoch=displaced.epoch, by=job.epoch
             )
@@ -261,17 +285,31 @@ class ProvingPlane:
             except ProverCrashed as exc:
                 self._finish(job.epoch, FAILED, reason="prover-crashed")
                 obs_metrics.PROOFS_FAILED.inc()
+                TIMELINE.record(
+                    job.epoch,
+                    proof={"state": FAILED, "reason": "prover-crashed"},
+                )
+                # The recovered worker flight tail rides with the
+                # crashed result: post-mortems survive the spawn
+                # boundary (ISSUE 11 satellite).
                 JOURNAL.record(
                     "anomaly",
                     what="proof-failed",
                     epoch=job.epoch,
                     error=repr(exc),
+                    worker_flight_events=len(exc.flight_tail),
+                    worker_flight_last=(
+                        exc.flight_tail[-1] if exc.flight_tail else None
+                    ),
                 )
                 log.error("epoch %d proof failed: %r", job.epoch, exc)
                 continue
             except BaseException as exc:  # noqa: BLE001 - a job must not kill the loop
                 self._finish(job.epoch, FAILED, reason="prove-error")
                 obs_metrics.PROOFS_FAILED.inc()
+                TIMELINE.record(
+                    job.epoch, proof={"state": FAILED, "reason": "prove-error"}
+                )
                 JOURNAL.record(
                     "anomaly",
                     what="proof-failed",
@@ -291,16 +329,46 @@ class ProvingPlane:
         # Deep attribution across the process boundary: the worker's
         # prove span tree lands under the epoch's stored trace root.
         TRACER.graft(job.epoch, result.spans)
+        # Cross-process metric aggregation: a pooled worker's registry
+        # snapshot rides back with the proof; the parent's own snapshot
+        # (inline pools) is already the local scrape, so skip it.
+        if result.metrics is not None and result.metrics.get("pid") != os.getpid():
+            FLEET.ingest(
+                result.metrics.get("source", f"prover-{result.metrics.get('pid')}"),
+                result.metrics,
+            )
+            obs_metrics.WORKER_SNAPSHOT_MERGES.inc(pool="prover")
         obs_metrics.PROVE_SECONDS.observe(result.prove_seconds)
         obs_metrics.PROOFS_COMPLETED.inc()
         status = self._finish(
             job.epoch, PROVED, prove_seconds=result.prove_seconds
+        )
+        if status.lag_seconds is not None:
+            obs_metrics.PROOF_LAG_SECONDS.observe(status.lag_seconds)
+        # End-to-end lineage completion: this proof covers every
+        # attestation bound to this epoch or an earlier (superseded)
+        # one — their freshness clocks stop here.
+        e2e = LINEAGE.epoch_proved(job.epoch)
+        TIMELINE.record(
+            job.epoch,
+            proof={
+                "state": PROVED,
+                "landed_unix": round(time.time(), 3),
+                "prove_seconds": round(result.prove_seconds, 4),
+                "lag_seconds": round(status.lag_seconds or 0.0, 4),
+            },
+            freshness={
+                "completed": len(e2e),
+                "p99_seconds": round(_percentile(e2e, 0.99), 4) if e2e else None,
+                "max_seconds": round(max(e2e), 4) if e2e else None,
+            },
         )
         JOURNAL.record(
             "proof-landed",
             epoch=job.epoch,
             seconds=round(result.prove_seconds, 3),
             lag_seconds=round(status.lag_seconds or 0.0, 3),
+            lineage_completed=len(e2e),
         )
         log.info(
             "epoch %d proved in %.2fs (%.2fs after submit)",

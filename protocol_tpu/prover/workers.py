@@ -23,21 +23,29 @@ phase timer goes quiet after the first job (PERF.md §16).
 from __future__ import annotations
 
 import os
+import tempfile
 import threading
 from concurrent.futures import BrokenExecutor, ProcessPoolExecutor, TimeoutError
 from multiprocessing import get_context
 
 from ..obs import metrics as obs_metrics
-from ..obs.journal import JOURNAL
+from ..obs.journal import (
+    JOURNAL,
+    collect_worker_dumps,
+    install_worker_dump_handler,
+)
 from .jobs import ProofJob, ProofResult, prove_job, prover_for
 
 
-def _worker_init(omp_threads: int) -> None:
+def _worker_init(omp_threads: int, dump_dir: str | None = None) -> None:
     """Runs in each spawned worker before any job: pin (or free) the
-    native runtime's OpenMP width and pre-load the zk runtime off the
-    first job's critical path."""
+    native runtime's OpenMP width, install the flight-recorder dump
+    handler (a SIGTERM'd — e.g. hung-and-terminated — worker leaves
+    its event ring behind for the parent's post-mortem), and pre-load
+    the zk runtime off the first job's critical path."""
     if omp_threads > 0:
         os.environ["OMP_NUM_THREADS"] = str(omp_threads)
+    install_worker_dump_handler(dump_dir, pool="prover")
     from ..zk import native as zk_native
 
     zk_native.available()
@@ -58,7 +66,14 @@ def _worker_prove(job: ProofJob, verify: bool) -> ProofResult:
 
 class ProverCrashed(RuntimeError):
     """A job's worker died (or timed out) ``max_retries + 1`` times;
-    the plane must fail the job with ``reason="prover-crashed"``."""
+    the plane must fail the job with ``reason="prover-crashed"``.
+    ``flight_tail`` carries whatever per-worker flight-recorder dumps
+    the pool recovered (a terminated hung worker dumps its ring on
+    SIGTERM; a hard-killed one leaves nothing)."""
+
+    def __init__(self, *args: object) -> None:
+        super().__init__(*args)
+        self.flight_tail: list = []
 
 
 class ProverPool:
@@ -92,6 +107,12 @@ class ProverPool:
         self._lock = threading.Lock()
         self._generation = 0
         self._executor: ProcessPoolExecutor | None = None
+        #: Flight-recorder tails recovered from crashed workers' dump
+        #: files, attached to the next ProverCrashed (under _lock).
+        self._flight_tail: list = []
+        self._dump_dir: str | None = (
+            tempfile.mkdtemp(prefix="prover_flight_") if self.workers > 0 else None
+        )
         if self.workers > 0:
             self._executor = self._make()
 
@@ -105,7 +126,7 @@ class ProverPool:
             max_workers=self.workers,
             mp_context=get_context("spawn"),
             initializer=_worker_init,
-            initargs=(self.omp_threads,),
+            initargs=(self.omp_threads, self._dump_dir),
         )
 
     def _snapshot(self) -> tuple[int, ProcessPoolExecutor | None]:
@@ -123,15 +144,34 @@ class ProverPool:
             self._executor = self._make()
             self._generation += 1
         # A hung worker survives shutdown(cancel_futures=True); kill it
-        # so a timeout doesn't leak a core-burning orphan.
-        for proc in list(getattr(old, "_processes", {}).values()):
+        # so a timeout doesn't leak a core-burning orphan.  SIGTERM
+        # also triggers the worker's flight-dump handler, so "what was
+        # the hung prover doing" survives into the dump dir.
+        procs = list(getattr(old, "_processes", {}).values())
+        for proc in procs:
             try:
                 proc.terminate()
             except (OSError, ValueError):
                 pass
+        for proc in procs:
+            try:
+                proc.join(timeout=2.0)
+            except (OSError, ValueError, AssertionError):
+                pass
         old.shutdown(wait=False, cancel_futures=True)
+        tails = collect_worker_dumps(self._dump_dir, pool="prover")
+        if tails:
+            with self._lock:
+                self._flight_tail.extend(tails)
         obs_metrics.PROVER_WORKER_RESTARTS.inc()
         JOURNAL.record("anomaly", what="prover-worker-crashed", generation=generation)
+
+    def take_flight_tail(self) -> list:
+        """Pop the recovered worker flight-recorder events (attached to
+        crashed jobs by :meth:`prove`)."""
+        with self._lock:
+            tail, self._flight_tail = self._flight_tail, []
+        return tail
 
     def prewarm(self, params, prover: str = "plonk", srs_path: str | None = None):
         """Build every worker's prover cache now (SRS + proving key),
@@ -172,10 +212,12 @@ class ProverPool:
                 self._restart(generation)
                 attempts += 1
                 if attempts > self.max_retries:
-                    raise ProverCrashed(
+                    crashed = ProverCrashed(
                         f"epoch {job.epoch} proof attempt died "
                         f"{attempts} time(s): {exc!r}"
-                    ) from exc
+                    )
+                    crashed.flight_tail = self.take_flight_tail()
+                    raise crashed from exc
                 JOURNAL.record(
                     "anomaly",
                     what="prove-retried",

@@ -701,11 +701,14 @@ class TestEpochLoopIngestRule:
         findings = _scan(
             tmp_path,
             "protocol_tpu/node/pipeline.py",
-            "import queue\nQ = queue.Queue(maxsize=1)\n"
+            "import queue\n"
+            "from protocol_tpu.obs import metrics as obs_metrics\n"
+            "Q = queue.Queue(maxsize=1)\n"
             "def submit(prepared):\n"
             "    Q.put_nowait(prepared)\n"
             "    Q.put(prepared, timeout=0.05)\n"
-            "    Q.put(prepared, block=False)\n",
+            "    Q.put(prepared, block=False)\n"
+            "    obs_metrics.PIPELINE_QUEUE_DEPTH.set(Q.qsize())\n",
         )
         assert findings == []
 
@@ -814,4 +817,91 @@ class TestEpochLoopProveRule:
             findings = scan_file(root / rel, root)
             assert [
                 f for f in findings if f.rule == "blocking-prove-in-epoch-loop"
+            ] == [], rel
+
+
+class TestUnobservedQueueRule:
+    """Pass 10 (ISSUE 11): every bounded queue constructed in
+    protocol_tpu/ must have a queue-depth gauge write in the same
+    file — backpressure must be scrapeable, not guessed."""
+
+    def test_bounded_queue_without_gauge_fires(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/ingest/stage.py",
+            "import queue\n"
+            "class Stage:\n"
+            "    def __init__(self):\n"
+            "        self._q = queue.Queue(maxsize=4)\n",
+        )
+        assert [f.rule for f in findings] == ["unobserved-queue"]
+        assert findings[0].file == "protocol_tpu/ingest/stage.py"
+        assert findings[0].line == 4
+
+    def test_positional_bound_fires_too(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/stage.py",
+            "from queue import Queue\nQ = Queue(16)\n",
+        )
+        assert [f.rule for f in findings] == ["unobserved-queue"]
+        assert findings[0].line == 2
+
+    def test_depth_gauge_write_in_file_quiets_the_rule(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/ingest/stage.py",
+            "import queue\n"
+            "from protocol_tpu.obs import metrics as obs_metrics\n"
+            "class Stage:\n"
+            "    def __init__(self):\n"
+            "        self._q = queue.Queue(maxsize=4)\n"
+            "    def push(self, item):\n"
+            "        self._q.put_nowait(item)\n"
+            "        obs_metrics.INGEST_QUEUE_DEPTH.set(\n"
+            "            self._q.qsize(), stage='submit')\n",
+        )
+        assert findings == []
+
+    def test_gauge_registration_with_queue_depth_name_quiets(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/stage.py",
+            "import queue\n"
+            "from protocol_tpu.obs.metrics import METRICS\n"
+            "DEPTH = METRICS.gauge('eigentrust_stage_queue_depth', 'd')\n"
+            "Q = queue.Queue(maxsize=4)\n",
+        )
+        assert findings == []
+
+    def test_unbounded_queues_and_rings_are_exempt(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/obs/ring.py",
+            "import collections\nimport queue\n"
+            "UNBOUNDED = queue.Queue()\n"
+            "ALSO_UNBOUNDED = queue.Queue(maxsize=0)\n"
+            "NEGATIVE = queue.Queue(maxsize=-1)\n"
+            "RING = collections.deque(maxlen=64)\n",
+        )
+        assert findings == []
+
+    def test_seeded_fixture_registered(self):
+        assert "unobserved-queue" in FIXTURES
+        assert FIXTURES["unobserved-queue"].kind == "ast"
+
+    def test_real_tree_queue_files_are_clean(self):
+        """The real bounded-queue constructors (ingest plane, epoch
+        pipeline) all register depth gauges — the rule stays quiet on
+        the live tree."""
+        root = FIXTURES_PATH.resolve().parents[2]
+        for rel in (
+            "protocol_tpu/ingest/plane.py",
+            "protocol_tpu/node/pipeline.py",
+            "protocol_tpu/prover/plane.py",
+            "protocol_tpu/obs/journal.py",
+        ):
+            findings = scan_file(root / rel, root)
+            assert [
+                f for f in findings if f.rule == "unobserved-queue"
             ] == [], rel

@@ -1,0 +1,678 @@
+"""Fleet observability plane (ISSUE 11): attestation lineage, epoch
+timelines, cross-process metric aggregation, the SLO engine, /healthz,
+and the generated metric catalog."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from protocol_tpu.node.epoch import Epoch
+from protocol_tpu.node.manager import Manager, ManagerConfig
+from protocol_tpu.obs import TIMELINE, TRACER, Tracer
+from protocol_tpu.obs.fleet import (
+    FleetAggregator,
+    fleet_prometheus_text,
+    load_directory,
+    publish_snapshot,
+    registry_snapshot,
+)
+from protocol_tpu.obs.lineage import LINEAGE, LineageTracker
+from protocol_tpu.obs.metrics import FRESHNESS_SECONDS, METRICS
+from protocol_tpu.obs.slo import (
+    SLOEngine,
+    SLObjective,
+    default_objectives,
+    seed_violation,
+)
+from protocol_tpu.obs.timeline import TimelineRegistry
+
+
+def _manager(prover: str = "commitment") -> Manager:
+    mgr = Manager(ManagerConfig(prover=prover))
+    mgr.generate_initial_attestations()
+    return mgr
+
+
+# ---------------------------------------------------------------------------
+# Lineage tracker
+# ---------------------------------------------------------------------------
+
+
+class TestLineageTracker:
+    def test_sampling_period(self):
+        t = LineageTracker(sample_every=4)
+        ids = [t.maybe_begin() for _ in range(8)]
+        assert sum(1 for lid in ids if lid) == 2
+        assert len(t) == 2
+
+    def test_unsampled_path_builds_no_state(self):
+        """The zero-allocation contract: with sampling off (or between
+        samples) the tracker builds NO per-item state — no entries, no
+        epoch cohorts, and the returned ID is the shared int 0."""
+        t = LineageTracker(sample_every=0)
+        for _ in range(1000):
+            assert t.maybe_begin() == 0
+        assert len(t) == 0
+        assert t.snapshot()["live"] == 0
+        assert t.ids_for_epoch(1) == ()
+        # Marks and drops against unsampled IDs are no-ops.
+        t.mark(0, "applied")
+        t.drop(0)
+        assert len(t) == 0
+
+    def test_full_lifecycle_observes_each_stage(self):
+        t = LineageTracker(sample_every=1)
+        before = {
+            s: FRESHNESS_SECONDS.count(stage=s)
+            for s in ("admitted", "applied", "included", "converged", "proof_landed")
+        }
+        lid = t.maybe_begin()
+        assert lid
+        t.mark(lid, "admitted")
+        t.mark(lid, "verified")
+        t.mark(lid, "applied")
+        assert t.bind_epoch(7) == (lid,)
+        assert t.ids_for_epoch(7) == (lid,)
+        assert t.ids_for_epoch(6) == ()
+        t.epoch_converged(7)
+        e2e = t.epoch_proved(7)
+        assert len(e2e) == 1 and e2e[0] >= 0
+        assert len(t) == 0  # completed entries evict
+        for stage, n0 in before.items():
+            assert FRESHNESS_SECONDS.count(stage=stage) == n0 + 1, stage
+
+    def test_later_proof_completes_earlier_cohorts(self):
+        """Supersede semantics: epoch 9's proof covers the cohort bound
+        to epoch 8 (whose own proof was displaced)."""
+        t = LineageTracker(sample_every=1)
+        a = t.maybe_begin()
+        t.mark(a, "applied")
+        t.bind_epoch(8)
+        b = t.maybe_begin()
+        t.mark(b, "applied")
+        t.bind_epoch(9)
+        assert set(t.ids_for_epoch(9)) == {a, b}
+        assert len(t.epoch_proved(9)) == 2
+
+    def test_drop_on_rejection(self):
+        t = LineageTracker(sample_every=1)
+        lid = t.maybe_begin()
+        t.drop(lid, reason="rejected")
+        assert len(t) == 0
+        t.mark(lid, "applied")  # late mark on a dropped entry: no-op
+        assert t.bind_epoch(1) == ()
+
+    def test_capacity_eviction_is_bounded(self):
+        t = LineageTracker(sample_every=1, max_entries=4)
+        for _ in range(10):
+            t.maybe_begin()
+        assert len(t) == 4
+
+
+# ---------------------------------------------------------------------------
+# Lineage across the spawn boundary
+# ---------------------------------------------------------------------------
+
+
+class TestLineageSpawnBoundary:
+    def test_proof_job_carries_lineage_ids(self):
+        mgr = _manager()
+        t = LINEAGE
+        t.configure(1)
+        try:
+            lid = t.maybe_begin()
+            t.mark(lid, "applied")
+            t.bind_epoch(5)
+            job = mgr.build_proof_job(Epoch(5))
+            assert job.lineage == (lid,)
+            assert all(isinstance(x, int) for x in job.lineage)
+        finally:
+            t.configure(0)
+            t.reset()
+
+    def test_unsampled_job_lineage_is_empty(self):
+        LINEAGE.configure(0)
+        LINEAGE.reset()
+        mgr = _manager()
+        job = mgr.build_proof_job(Epoch(5))
+        assert job.lineage == ()
+
+    def test_lineage_and_seed_are_independent(self):
+        """Sampling must never perturb proof bytes: job_seed ignores
+        the lineage payload."""
+        from dataclasses import replace
+
+        from protocol_tpu.prover import job_seed
+
+        mgr = _manager()
+        job = mgr.build_proof_job(Epoch(6))
+        assert job_seed(job) == job_seed(replace(job, lineage=(1, 2, 3)))
+
+    def test_spawned_worker_echoes_lineage_and_ships_metrics(self):
+        """The spawn-boundary round trip: a pooled worker returns the
+        flat lineage tuple AND its own registry snapshot (pid differs
+        from the parent's)."""
+        from protocol_tpu.prover.workers import ProverPool
+
+        mgr = _manager()
+        from dataclasses import replace
+
+        job = replace(mgr.build_proof_job(Epoch(7)), lineage=(11, 23))
+        pool = ProverPool(workers=1)
+        try:
+            result = pool.prove(job)
+        finally:
+            pool.close()
+        assert result.lineage == (11, 23)
+        assert result.metrics is not None
+        assert result.metrics["pid"] != os.getpid()
+        assert result.metrics["source"] == f"prover-{result.metrics['pid']}"
+        # The worker's own span-fed histograms rode back with the proof.
+        assert "eigentrust_phase_seconds" in result.metrics["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# Tracer.graft early-arrival parking under concurrent epochs
+# ---------------------------------------------------------------------------
+
+
+class TestGraftConcurrentEpochs:
+    def test_parked_grafts_land_per_epoch_with_two_open_roots(self):
+        """Two epochs' root spans are open concurrently (pipelined
+        ticks) while proofs for BOTH arrive early: each graft parks,
+        and each lands under its own epoch when that trace stores."""
+        tracer = Tracer()
+        ready = threading.Barrier(3)
+        release = {7: threading.Event(), 8: threading.Event()}
+
+        def run_epoch(n: int):
+            with tracer.epoch(n):
+                ready.wait(timeout=10)
+                release[n].wait(timeout=10)
+
+        threads = [
+            threading.Thread(target=run_epoch, args=(n,)) for n in (7, 8)
+        ]
+        for th in threads:
+            th.start()
+        ready.wait(timeout=10)
+        # Both roots are open: neither trace is stored yet, so both
+        # grafts must park (graft returns False) instead of dropping.
+        assert tracer.graft(7, {"name": "prove", "children": []}) is False
+        assert tracer.graft(8, {"name": "prove", "children": []}) is False
+        # Close epoch 8 FIRST — out of submission order, like a fast
+        # prove beating a cold-compile tick.
+        release[8].set()
+        threads[1].join(timeout=10)
+        release[7].set()
+        threads[0].join(timeout=10)
+        for n in (7, 8):
+            trace = tracer.get_trace(n)
+            assert trace is not None
+            names = [c["name"] for c in trace["children"]]
+            assert names.count("prove") == 1, (n, names)
+
+    def test_graft_for_evicted_epoch_is_dropped_not_parked(self):
+        tracer = Tracer(keep_epochs=2)
+        for n in (1, 2, 3):
+            with tracer.epoch(n):
+                pass
+        assert tracer.graft(1, {"name": "prove"}) is False
+        # Epoch 1 was ring-evicted; its pending-graft slot must not
+        # grow unboundedly either.
+        assert 1 not in tracer._pending_grafts
+
+
+# ---------------------------------------------------------------------------
+# Timeline registry
+# ---------------------------------------------------------------------------
+
+
+class TestTimelineRegistry:
+    def test_merge_semantics_one_level_deep(self):
+        tl = TimelineRegistry()
+        tl.record(4, proof={"state": "queued", "submitted_unix": 1.0})
+        tl.record(4, proof={"state": "proved"}, converge={"iterations": 3})
+        rec = tl.get(4)
+        assert rec["proof"] == {"state": "proved", "submitted_unix": 1.0}
+        assert rec["converge"]["iterations"] == 3
+        assert rec["epoch"] == 4
+
+    def test_ring_bound_evicts_oldest(self):
+        tl = TimelineRegistry(keep_epochs=3)
+        for n in range(6):
+            tl.record(n, x=n)
+        assert tl.epochs() == [3, 4, 5]
+        assert tl.latest_epoch() == 5
+        assert tl.latest()["x"] == 5
+
+    def test_seconds_since_last_tick(self):
+        tl = TimelineRegistry()
+        assert tl.seconds_since_last_tick() is None
+        tl.record(1, tick_ended_unix=time.time() - 5.0)
+        since = tl.seconds_since_last_tick()
+        assert since is not None and 4.0 < since < 30.0
+
+    def test_epoch_root_span_close_feeds_global_timeline(self):
+        epoch = 987_654_001
+        with TRACER.epoch(epoch):
+            with TRACER.span("converge"):
+                pass
+        rec = TIMELINE.get(epoch)
+        assert rec is not None
+        assert "converge" in rec["phases"]
+        assert rec["tick_seconds"] >= 0
+        assert rec["error"] is False
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregation
+# ---------------------------------------------------------------------------
+
+
+class TestFleetAggregation:
+    def test_snapshot_skips_empty_and_carries_pid(self):
+        snap = registry_snapshot()
+        assert snap["pid"] == os.getpid()
+        assert snap["version"] == 1
+        # skip_empty: only touched metrics ship.
+        for entry in snap["metrics"].values():
+            assert entry.get("samples") or entry.get("hist")
+
+    def test_render_merges_with_process_labels(self):
+        agg = FleetAggregator()
+        reg_worker = type(METRICS)()  # fresh registry as "the worker"
+        c = reg_worker.counter("eigentrust_test_fleet_total", "t", ("kind",))
+        c.inc(3, kind="x")
+        h = reg_worker.histogram("eigentrust_test_fleet_seconds", "t", buckets=(1.0,))
+        h.observe(0.5)
+        agg.ingest("worker-1", registry_snapshot(reg_worker, source="worker-1"))
+        text = fleet_prometheus_text(aggregator=agg)
+        assert (
+            'eigentrust_test_fleet_total{kind="x",process="worker-1"} 3' in text
+        )
+        assert 'process="node"' in text
+        assert (
+            'eigentrust_test_fleet_seconds_count{process="worker-1"} 1' in text
+        )
+
+    def test_reingest_same_source_never_double_counts(self):
+        agg = FleetAggregator()
+        reg = type(METRICS)()
+        c = reg.counter("eigentrust_test_refleet_total", "t")
+        c.inc(5)
+        snap = registry_snapshot(reg, source="w")
+        agg.ingest("w", snap)
+        agg.ingest("w", registry_snapshot(reg, source="w"))  # re-ship
+        text = fleet_prometheus_text(aggregator=agg)
+        assert 'eigentrust_test_refleet_total{process="w"} 5' in text
+        assert text.count("eigentrust_test_refleet_total{") == 1
+
+    def test_directory_exchange_round_trip(self, tmp_path):
+        reg = type(METRICS)()
+        reg.counter("eigentrust_test_dir_total", "t").inc(2)
+        path = publish_snapshot(tmp_path, "A", reg)
+        assert path.exists()
+        # A half-written sibling must not break the merge.
+        (tmp_path / "fleet-B.json").write_text("{not json")
+        agg = FleetAggregator()
+        ingested = load_directory(tmp_path, agg)
+        assert ingested == ["proc-A"]
+        text = fleet_prometheus_text(aggregator=agg)
+        assert 'eigentrust_test_dir_total{process="proc-A"} 2' in text
+
+    def test_directory_skips_own_pid(self, tmp_path):
+        publish_snapshot(tmp_path, "self")
+        agg = FleetAggregator()
+        assert load_directory(tmp_path, agg, skip_pid=os.getpid()) == []
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+class TestSLOEngine:
+    def test_ok_and_violation_verdicts(self):
+        engine = SLOEngine()
+        value = {"v": 1.0}
+        engine.register(
+            SLObjective(
+                name="test-max",
+                description="d",
+                target=2.0,
+                value_fn=lambda: value["v"],
+            )
+        )
+        out = engine.evaluate()
+        assert out["ok"] and out["objectives"]["test-max"]["ok"]
+        value["v"] = 3.0
+        out = engine.evaluate()
+        assert not out["ok"]
+        assert out["objectives"]["test-max"]["value"] == 3.0
+
+    def test_no_data_counts_as_ok(self):
+        engine = SLOEngine()
+        engine.register(
+            SLObjective(
+                name="test-none", description="d", target=1.0, value_fn=lambda: None
+            )
+        )
+        assert engine.evaluate()["ok"]
+
+    def test_min_direction(self):
+        engine = SLOEngine()
+        engine.register(
+            SLObjective(
+                name="test-min",
+                description="d",
+                target=5.0,
+                direction="min",
+                value_fn=lambda: 4.0,
+            )
+        )
+        assert not engine.evaluate()["ok"]
+
+    def test_burn_rate_and_transition_counter(self):
+        from protocol_tpu.obs.metrics import SLO_VIOLATIONS
+
+        engine = SLOEngine()
+        value = {"v": 0.0}
+        engine.register(
+            SLObjective(
+                name="test-burn",
+                description="d",
+                target=1.0,
+                value_fn=lambda: value["v"],
+                window=4,
+            )
+        )
+        v0 = SLO_VIOLATIONS.value(objective="test-burn")
+        engine.evaluate()  # ok
+        value["v"] = 9.0
+        engine.evaluate()  # violating (transition)
+        engine.evaluate()  # still violating (no new transition)
+        out = engine.evaluate()
+        assert SLO_VIOLATIONS.value(objective="test-burn") == v0 + 1
+        assert out["objectives"]["test-burn"]["burn_rate"] == 0.75
+
+    def test_value_fn_exception_is_no_data(self):
+        engine = SLOEngine()
+        engine.register(
+            SLObjective(
+                name="test-raise",
+                description="d",
+                target=1.0,
+                value_fn=lambda: 1 / 0,
+            )
+        )
+        out = engine.evaluate()
+        assert out["ok"]
+        assert out["objectives"]["test-raise"]["value"] is None
+
+    def test_default_objective_set(self):
+        names = {o.name for o in default_objectives(epoch_interval_s=10)}
+        assert {
+            "freshness-p99",
+            "proof-lag-p99",
+            "epoch-cadence",
+            "shed-rate",
+            "residual-stall",
+            "score-drift-linf",
+        } <= names
+        cadence = next(
+            o for o in default_objectives(epoch_interval_s=10)
+            if o.name == "epoch-cadence"
+        )
+        assert cadence.target == 30.0
+
+    def test_seeded_violation_always_fails(self):
+        engine = SLOEngine()
+        seed_violation(engine)
+        out = engine.evaluate()
+        assert not out["ok"]
+        assert not out["objectives"]["seeded-violation"]["ok"]
+
+    def test_histogram_quantile(self):
+        reg = type(METRICS)()
+        h = reg.histogram("eigentrust_test_q", "t", buckets=(1.0, 2.0, 4.0))
+        assert h.quantile(0.99) is None
+        for v in (0.5, 1.5, 1.5, 3.0):
+            h.observe(v)
+        q50 = h.quantile(0.5)
+        assert 1.0 <= q50 <= 2.0
+        assert h.quantile(1.0) == 4.0
+        h.observe(100.0)  # lands in +Inf: quantile clamps to last bound
+        assert h.quantile(1.0) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# Server surfaces: /healthz, /timeline, /slo
+# ---------------------------------------------------------------------------
+
+
+class TestServerSurfaces:
+    def test_healthz_without_node_reports_from_globals(self):
+        from protocol_tpu.node.server import handle_request
+
+        status, body = handle_request("GET", "/healthz", _manager())
+        health = json.loads(body)
+        assert status in (200, 503)
+        assert health["status"] in ("ok", "degraded", "failed")
+        assert "epoch" in health["components"]
+
+    def test_healthz_degraded_before_first_epoch_then_ok(self):
+        from protocol_tpu.node.server import node_health
+
+        TIMELINE.reset()
+        status, health = node_health(None)
+        assert status == 200
+        assert health["status"] == "degraded"
+        assert "no-epoch-yet" in health["degraded"]
+        TIMELINE.record(1, tick_ended_unix=time.time())
+        status, health = node_health(None)
+        assert health["status"] == "ok"
+        TIMELINE.reset()
+
+    def test_healthz_failed_when_epoch_loop_stalls(self):
+        from protocol_tpu.node.config import ProtocolConfig
+        from protocol_tpu.node.server import Node, node_health
+
+        TIMELINE.reset()
+        TIMELINE.record(1, tick_ended_unix=time.time() - 1000.0)
+        node = Node.from_config(
+            ProtocolConfig(epoch_interval=2, prover="commitment")
+        )
+        status, health = node_health(node)
+        assert status == 503
+        assert health["status"] == "failed"
+        assert "epoch-loop-stalled" in health["problems"]
+        TIMELINE.reset()
+
+    def test_timeline_endpoint(self):
+        from protocol_tpu.node.server import handle_request
+
+        TIMELINE.record(41, phases={"converge": 0.5})
+        mgr = _manager()
+        status, body = handle_request("GET", "/timeline/41", mgr)
+        assert status == 200
+        assert json.loads(body)["phases"]["converge"] == 0.5
+        status, body = handle_request("GET", "/timeline/latest", mgr)
+        assert status == 200
+        status, _ = handle_request("GET", "/timeline/999999999", mgr)
+        assert status == 404
+        status, _ = handle_request("GET", "/timeline/nope", mgr)
+        assert status == 400
+        TIMELINE.reset()
+
+    def test_slo_endpoint_evaluates(self):
+        from protocol_tpu.node.server import handle_request
+        from protocol_tpu.obs.slo import SLO_ENGINE
+
+        SLO_ENGINE.register(
+            SLObjective(
+                name="test-endpoint",
+                description="d",
+                target=1.0,
+                value_fn=lambda: 0.5,
+            )
+        )
+        try:
+            status, body = handle_request("GET", "/slo", _manager())
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["objectives"]["test-endpoint"]["ok"]
+        finally:
+            SLO_ENGINE.unregister("test-endpoint")
+
+    def test_fleet_scrape_endpoint(self):
+        from protocol_tpu.node.server import handle_request
+
+        status, body = handle_request("GET", "/metrics/fleet", _manager())
+        assert status == 200
+        assert 'process="node"' in body
+
+
+# ---------------------------------------------------------------------------
+# Worker flight-recorder dumps (spawn-boundary post-mortems)
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerFlightDumps:
+    def test_collect_reads_journals_and_deletes(self, tmp_path):
+        from protocol_tpu.obs.journal import JOURNAL, collect_worker_dumps
+
+        dump = tmp_path / "flight-prover-123.jsonl"
+        events = [{"kind": "span", "name": "msm", "seq": i} for i in range(30)]
+        dump.write_text("".join(json.dumps(e) + "\n" for e in events))
+        recovered = collect_worker_dumps(tmp_path, pool="prover", tail_events=5)
+        assert len(recovered) == 6  # tail_events + the dump marker slot
+        assert recovered[-1]["name"] == "msm"
+        assert not dump.exists()
+        tail = JOURNAL.tail(5)
+        assert any(e["kind"] == "worker-flight-tail" for e in tail)
+
+    def test_collect_empty_or_missing_dir(self, tmp_path):
+        from protocol_tpu.obs.journal import collect_worker_dumps
+
+        assert collect_worker_dumps(None, pool="x") == []
+        assert collect_worker_dumps(tmp_path / "absent", pool="x") == []
+
+    def test_worker_init_installs_sigterm_handler(self, tmp_path):
+        """The worker-bootstrap half, exercised in-process: install the
+        handler, then invoke it the way signal delivery would (in a
+        child fork so os._exit doesn't kill the test runner)."""
+        import signal
+        import subprocess
+        import sys
+
+        code = f"""
+import json, os, signal, sys
+sys.path.insert(0, {json.dumps(str(tmp_path.parent))!s})
+sys.path.insert(0, {json.dumps(os.getcwd())})
+from protocol_tpu.obs.journal import JOURNAL, install_worker_dump_handler
+install_worker_dump_handler({json.dumps(str(tmp_path))}, pool="prover")
+JOURNAL.record("test-event", n=1)
+os.kill(os.getpid(), signal.SIGTERM)
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=60
+        )
+        assert proc.returncode == 143, proc.stderr.decode()
+        dumps = list(tmp_path.glob("flight-prover-*.jsonl"))
+        assert len(dumps) == 1
+        lines = [json.loads(x) for x in dumps[0].read_text().splitlines()]
+        assert any(e.get("kind") == "test-event" for e in lines)
+        assert lines[-1]["kind"] == "journal-dump"
+
+
+# ---------------------------------------------------------------------------
+# Metric catalog doc (METRICS.md)
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsCatalogDoc:
+    def test_committed_catalog_matches_registry(self):
+        """METRICS.md is generated from the registry; any emitted-but-
+        undocumented metric (or stale row) fails here.  Regenerate with
+        `python tools/gen_metrics_md.py`."""
+        import pathlib
+        import sys
+
+        root = pathlib.Path(__file__).resolve().parent.parent
+        sys.path.insert(0, str(root / "tools"))
+        try:
+            from gen_metrics_md import metrics_markdown
+        finally:
+            sys.path.pop(0)
+        committed = (root / "METRICS.md").read_text()
+        assert committed == metrics_markdown(), (
+            "METRICS.md is stale — run `python tools/gen_metrics_md.py`"
+        )
+
+    def test_every_registered_metric_documented(self):
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parent.parent
+        committed = (root / "METRICS.md").read_text()
+        for metric in METRICS.collect():
+            assert f"`{metric.name}`" in committed, (
+                f"metric {metric.name} emitted but not documented in METRICS.md"
+            )
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: lineage through the ingest plane
+# ---------------------------------------------------------------------------
+
+
+class TestLineageThroughIngestPlane:
+    def test_accepted_attestation_walks_the_stages(self):
+        from protocol_tpu.ingest import IngestPlane, IngestPlaneConfig
+
+        mgr = _manager()
+        LINEAGE.configure(1)
+        LINEAGE.reset()
+        before = FRESHNESS_SECONDS.count(stage="applied")
+        atts = list(mgr.attestations.values())
+        try:
+            with IngestPlane(mgr, IngestPlaneConfig(workers=0)) as plane:
+                future = plane.submit(atts[0])
+                result = future.result(timeout=30)
+                assert result.accepted, result
+                assert plane.drain(timeout=30)
+            assert FRESHNESS_SECONDS.count(stage="applied") == before + 1
+            snap = LINEAGE.snapshot()
+            assert snap["by_stage"].get("applied") == 1
+            # The epoch absorbs it; its proof completes it.
+            bound = LINEAGE.bind_epoch(12)
+            assert len(bound) == 1
+            assert len(LINEAGE.epoch_proved(12)) == 1
+        finally:
+            LINEAGE.configure(0)
+            LINEAGE.reset()
+
+    def test_rejected_attestation_drops_lineage(self):
+        from protocol_tpu.ingest import IngestPlane, IngestPlaneConfig
+
+        mgr = _manager()
+        LINEAGE.configure(1)
+        LINEAGE.reset()
+        atts = list(mgr.attestations.values())
+        try:
+            with IngestPlane(mgr, IngestPlaneConfig(workers=0)) as plane:
+                # Same digest twice: the second dies in dedup.
+                plane.submit(atts[0]).result(timeout=30)
+                result = plane.submit(atts[0]).result(timeout=30)
+                assert not result.accepted
+                assert plane.drain(timeout=30)
+            snap = LINEAGE.snapshot()
+            assert snap["live"] == 1  # only the accepted one survives
+        finally:
+            LINEAGE.configure(0)
+            LINEAGE.reset()

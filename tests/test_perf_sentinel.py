@@ -402,3 +402,48 @@ class TestCommBytesSeries:
         report = json.loads(out.read_text())
         assert any("MULTICHIP_r06.json" in f for f in report["history_files"])
         assert any("comm_bytes_per_iter" in k for k in report["series"])
+
+
+class TestObsFreshnessSeries:
+    def test_obs_rounds_feed_the_gate(self, tmp_path):
+        """ISSUE 11: OBS_r*.json is in the default globs, its
+        ``entries`` list is walked, and freshness_p99_ms /
+        obs_overhead_pct gate upward."""
+        for i, (fresh, overhead) in enumerate(
+            [(9000.0, 0.2), (30000.0, 2.5)], start=1
+        ):
+            (tmp_path / f"OBS_r{i:02d}.json").write_text(
+                json.dumps(
+                    {
+                        "n": i,
+                        "entries": [
+                            {
+                                "metric": "end-to-end freshness (200k/2M churned)",
+                                "value": fresh,
+                                "unit": "ms p99 accepted-to-proven",
+                                "freshness_p99_ms": fresh,
+                            },
+                            {
+                                "metric": "lineage+SLO overhead",
+                                "obs_overhead_pct": overhead,
+                            },
+                        ],
+                    }
+                )
+            )
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1  # r02 regressed both series vs r01
+        report = json.loads(out.read_text())
+        assert {
+            "end-to-end freshness (200k/2M churned) :: freshness_p99_ms",
+            "lineage+SLO overhead :: obs_overhead_pct",
+        } <= set(report["regressions"])
+
+    def test_committed_obs_round_feeds_the_gate(self, tmp_path):
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(REPO), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert any("OBS_r01.json" in f for f in report["history_files"])
+        assert any("freshness_p99_ms" in k for k in report["series"])

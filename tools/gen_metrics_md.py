@@ -1,0 +1,91 @@
+"""Generate METRICS.md — the auto-generated metric catalog.
+
+The registry (``protocol_tpu/obs/metrics.py``) is the single source of
+truth for every metric the node emits; this tool renders it as a
+markdown table (name, type, labels, help) so the catalog in the repo
+can never drift silently: ``tests/test_obs_fleet.py`` regenerates the
+document in-memory and fails when the committed METRICS.md differs —
+an emitted-but-undocumented metric (or a stale doc row) fails tier-1,
+not a reviewer's memory.
+
+Run: ``python tools/gen_metrics_md.py [--check]``
+(``--check`` exits non-zero instead of rewriting, for CI.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+HEADER = """\
+# Metric catalog
+
+Auto-generated from the registry in `protocol_tpu/obs/metrics.py` by
+`tools/gen_metrics_md.py` — do not edit by hand; regenerate after any
+metric change (`tests/test_obs_fleet.py::TestMetricsCatalogDoc` fails
+on drift).  Every metric is served at `GET /metrics` (Prometheus
+exposition format); `GET /metrics/fleet` serves the same series merged
+across worker/sibling processes with a `process` label.
+
+| Metric | Type | Labels | Help |
+|---|---|---|---|
+"""
+
+
+def _escape_cell(text: str) -> str:
+    return text.replace("|", "\\|").replace("\n", " ")
+
+
+def metrics_markdown() -> str:
+    """The catalog document, rendered from the live registry."""
+    # Importing the metrics module registers the full catalog; the
+    # repo convention keeps every metric declaration there (worker
+    # and analyzer modules reuse those objects), so one import sees
+    # everything the node can emit.
+    from protocol_tpu.obs.metrics import METRICS
+
+    rows = []
+    for metric in sorted(METRICS.collect(), key=lambda m: m.name):
+        labels = ", ".join(metric.labelnames) if metric.labelnames else "—"
+        rows.append(
+            f"| `{metric.name}` | {metric.kind} | {labels} "
+            f"| {_escape_cell(metric.help)} |"
+        )
+    return HEADER + "\n".join(rows) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--out",
+        default=str(Path(__file__).resolve().parent.parent / "METRICS.md"),
+    )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 if the committed catalog differs (CI mode)",
+    )
+    args = ap.parse_args(argv)
+    doc = metrics_markdown()
+    out = Path(args.out)
+    if args.check:
+        current = out.read_text() if out.exists() else ""
+        if current != doc:
+            print(
+                f"gen_metrics_md: {out} is stale — regenerate with "
+                "`python tools/gen_metrics_md.py`",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"gen_metrics_md: {out} is current")
+        return 0
+    out.write_text(doc)
+    print(f"gen_metrics_md: wrote {out} ({doc.count(chr(10)) - 10} metrics)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

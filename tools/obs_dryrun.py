@@ -1,16 +1,27 @@
 """One-epoch node dryrun + observability scrape (the CI obs-dryrun job).
 
 Boots a real node (commitment prover, tpu-sparse open-graph backend) on
-a loopback port, lets exactly one epoch tick land, then scrapes the
-observability surface over the actual HTTP socket:
+a loopback port, POSTs a handful of signed attestations through the
+admission plane (lineage-sampled at 1:1), lets epoch ticks land until
+their lineage completes end-to-end, then scrapes the observability
+surface over the actual HTTP socket:
 
 - ``GET /metrics``  -> ``METRICS_scrape.txt`` (Prometheus text format)
 - ``GET /trace/latest`` -> ``TRACE_epoch0.json`` (the epoch's span tree)
+- ``GET /timeline/latest`` -> ``TIMELINE_latest.json`` (the epoch's
+  joined record: watermarks, phases, proof lifecycle, freshness)
+- ``GET /slo`` -> ``SLO.json`` (every objective green, or exit 1)
+- ``GET /healthz`` (ok/degraded verdict with component state)
+- ``GET /metrics/fleet`` (the process-labeled fleet-merged scrape)
 
-and asserts the ISSUE 4 acceptance shape: the metrics parse as
-Prometheus samples, the residual histogram count equals the iteration
-gauge, and the span tree roots at ``epoch_tick`` with the canonical
-phase children.  Exit code 0 iff everything held.
+and asserts the ISSUE 4 + ISSUE 11 acceptance shapes: metrics parse,
+residual count == iterations, span tree roots at ``epoch_tick``,
+end-to-end freshness observed (``stage="proof_landed"``), the timeline
+joins phase + proof fragments, every SLO objective holds, and the
+fleet scrape carries ``process`` labels.  ``--seed-slo-violation``
+registers an objective that cannot pass — the run must then FAIL,
+which is the CI self-check that a regressing objective fails the
+build.  Exit code 0 iff everything held.
 
 Run: ``JAX_PLATFORMS=cpu python tools/obs_dryrun.py [--out-dir DIR]``
 """
@@ -36,10 +47,33 @@ async def _http_get(port: int, path: str) -> tuple[str, str]:
     return head, body
 
 
-async def _dryrun(out_dir: Path, epoch_interval: int, timeout_s: float) -> int:
+async def _http_post(port: int, path: str, payload: bytes) -> tuple[str, str]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(
+        (
+            f"POST {path} HTTP/1.1\r\nhost: dryrun\r\n"
+            f"content-length: {len(payload)}\r\n\r\n"
+        ).encode()
+        + payload
+    )
+    await writer.drain()
+    response = (await reader.read()).decode()
+    writer.close()
+    head, _, body = response.partition("\r\n\r\n")
+    return head, body
+
+
+async def _dryrun(
+    out_dir: Path,
+    epoch_interval: int,
+    timeout_s: float,
+    seed_slo_violation: bool = False,
+) -> int:
+    from protocol_tpu.node.attestation import AttestationData
     from protocol_tpu.node.config import ProtocolConfig
     from protocol_tpu.node.server import Node
     from protocol_tpu.obs import TRACER, configure_logging
+    from protocol_tpu.obs.metrics import FRESHNESS_SECONDS
 
     configure_logging()
     cfg = ProtocolConfig(
@@ -47,18 +81,47 @@ async def _dryrun(out_dir: Path, epoch_interval: int, timeout_s: float) -> int:
         endpoint=((127, 0, 0, 1), 0),
         prover="commitment",
         trust_backend="tpu-sparse",
+        # Sample every accepted attestation so the handful POSTed below
+        # all carry lineage through to proof_landed.
+        lineage_sample_every=1,
     )
     node = Node.from_config(cfg)
     await node.start()
+    if seed_slo_violation:
+        from protocol_tpu.obs.slo import seed_violation
+
+        seed_violation()
+        print("obs_dryrun: seeded an always-violating SLO objective")
     port = node._server.sockets[0].getsockname()[1]
     print(f"obs_dryrun: node on 127.0.0.1:{port}, interval {epoch_interval}s")
 
-    # Wait for the first epoch tick to complete (its trace appearing is
-    # the completion signal — the tree is stored at tick end).
+    # Feed the admission plane a few real signed attestations (the
+    # node's own boot-time self-attestations, re-POSTed over the
+    # socket) so lineage sampling has an end-to-end stream to follow.
+    posted = 0
+    for att in list(node.manager.attestations.values()):
+        payload = AttestationData.from_attestation(att).to_bytes()
+        head, body = await _http_post(port, "/attestation", payload)
+        verdict = json.loads(body)
+        assert verdict["accepted"], verdict
+        posted += 1
+    print(f"obs_dryrun: posted {posted} attestations through the plane")
+
+    # Wait until (a) an epoch tick landed AND (b) the posted lineage
+    # completed end-to-end (its including epoch's proof landed) — up
+    # to two ticks when the first boundary races the POSTs.
+    def freshness_done() -> bool:
+        return FRESHNESS_SECONDS.count(stage="proof_landed") >= 1
+
     waited = 0.0
-    while TRACER.latest_epoch() is None:
+    while TRACER.latest_epoch() is None or not freshness_done():
         if waited > timeout_s:
-            print("obs_dryrun: no epoch tick within timeout", file=sys.stderr)
+            print(
+                "obs_dryrun: no epoch tick / lineage completion within "
+                f"timeout (traced={TRACER.epochs()}, "
+                f"proof_landed={FRESHNESS_SECONDS.count(stage='proof_landed')})",
+                file=sys.stderr,
+            )
             await node.stop()
             return 1
         await asyncio.sleep(0.25)
@@ -70,6 +133,11 @@ async def _dryrun(out_dir: Path, epoch_interval: int, timeout_s: float) -> int:
     _, trace_by_number = await _http_get(port, f"/trace/{latest}")
     drift_head, drift_body = await _http_get(port, "/scores/drift")
     flight_head, flight_body = await _http_get(port, "/debug/flight")
+    timeline_head, timeline_body = await _http_get(port, "/timeline/latest")
+    _, timeline_by_number = await _http_get(port, f"/timeline/{latest}")
+    slo_head, slo_body = await _http_get(port, "/slo")
+    health_head, health_body = await _http_get(port, "/healthz")
+    fleet_head, fleet_body = await _http_get(port, "/metrics/fleet")
     await node.stop()
 
     assert "200 OK" in metrics_head, metrics_head
@@ -150,16 +218,91 @@ async def _dryrun(out_dir: Path, epoch_interval: int, timeout_s: float) -> int:
     span_names = {e.get("name") for e in flight if e["kind"] == "span"}
     assert "epoch_tick" in span_names and "converge" in span_names, span_names
 
+    # -- fleet-plane surfaces (ISSUE 11) --------------------------------
+    assert "200 OK" in timeline_head, timeline_head
+    assert "200 OK" in slo_head, slo_head
+    assert "200 OK" in fleet_head, fleet_head
+    assert "text/plain; version=0.0.4" in fleet_head, fleet_head
+
+    # Timeline: the joined epoch record — phase durations from the
+    # span tree, the ingest watermark from the host stage, the proof
+    # lifecycle, and /timeline/latest ≡ /timeline/<epoch>.
+    assert timeline_body == timeline_by_number, "timeline latest diverges"
+    timeline = json.loads(timeline_body)
+    assert timeline["epoch"] == latest, timeline
+    assert "phases" in timeline and "converge" in timeline["phases"], timeline
+    assert timeline.get("graph", {}).get("peers", 0) >= 1, timeline
+    assert timeline.get("proof", {}).get("state") == "proved", timeline
+    assert timeline.get("converge", {}).get("iterations", 0) >= 1, timeline
+
+    # End-to-end freshness: the POSTed lineage completed, so every hop
+    # histogram has samples and proof_landed is the headline.
+    for stage in ("admitted", "verified", "applied", "included", "proof_landed"):
+        key = f'eigentrust_freshness_seconds_count{{stage="{stage}"}}'
+        assert samples.get(key, 0) >= 1, (stage, key)
+
+    # SLO engine: every objective evaluated and green (a seeded
+    # violation flips this and the dryrun exits 1 — the CI self-check
+    # that the gate can fail).
+    slo = json.loads(slo_body)
+    objectives = slo.get("objectives", {})
+    for required in (
+        "freshness-p99",
+        "proof-lag-p99",
+        "epoch-cadence",
+        "shed-rate",
+        "residual-stall",
+    ):
+        assert required in objectives, (required, sorted(objectives))
+    violating = sorted(k for k, o in objectives.items() if not o["ok"])
+    slo_ok = bool(slo.get("ok")) and not violating
+
+    # Health: the node just served an epoch, so the verdict is ok (or
+    # degraded only by an SLO violation when one was seeded).
+    health = json.loads(health_body)
+    assert health["status"] in ("ok", "degraded"), health
+    assert "200 OK" in health_head, health_head
+    assert health["components"]["epoch"]["latest"] == latest, health
+    if not seed_slo_violation:
+        assert health["status"] == "ok", health
+
+    # Fleet scrape: one coherent exposition with per-process labels —
+    # the node process at minimum (spawn workers / jax.distributed
+    # siblings add their own process rows when present).
+    assert 'process="node"' in fleet_body, fleet_body[:400]
+    fleet_names = {
+        line.split("{", 1)[0]
+        for line in fleet_body.splitlines()
+        if line and not line.startswith("#")
+    }
+    assert "eigentrust_epochs_total" in fleet_names
+
     out_dir.mkdir(parents=True, exist_ok=True)
     (out_dir / "METRICS_scrape.txt").write_text(metrics_body)
     (out_dir / "TRACE_epoch0.json").write_text(json.dumps(tree, indent=2) + "\n")
     (out_dir / "FLIGHT_tail.jsonl").write_text(flight_body)
-    print(
-        f"obs_dryrun: OK — epoch {tree['attrs']['epoch']}, "
-        f"{int(iterations)} iterations, {int(residual_count)} residuals, "
-        f"phases {child_names}, {len(flight)} flight events; "
-        f"artifacts in {out_dir}/"
+    (out_dir / "TIMELINE_latest.json").write_text(
+        json.dumps(timeline, indent=2) + "\n"
     )
+    (out_dir / "SLO.json").write_text(json.dumps(slo, indent=2) + "\n")
+    landed = samples.get(
+        'eigentrust_freshness_seconds_count{stage="proof_landed"}', 0
+    )
+    print(
+        f"obs_dryrun: epoch {tree['attrs']['epoch']}, "
+        f"{int(iterations)} iterations, {int(residual_count)} residuals, "
+        f"phases {child_names}, {len(flight)} flight events, "
+        f"{int(landed)} lineage completions, "
+        f"health={health['status']}; artifacts in {out_dir}/"
+    )
+    if not slo_ok:
+        print(
+            f"obs_dryrun: SLO VIOLATION — objectives {violating} not met "
+            "(see SLO.json)",
+            file=sys.stderr,
+        )
+        return 1
+    print("obs_dryrun: OK — all SLO objectives green")
     return 0
 
 
@@ -174,9 +317,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--timeout", type=float, default=120.0, help="max wait for the tick"
     )
+    ap.add_argument(
+        "--seed-slo-violation",
+        action="store_true",
+        help="register an always-violating SLO objective; the dryrun "
+        "must then exit non-zero (the CI gate self-check)",
+    )
     args = ap.parse_args(argv)
     return asyncio.run(
-        _dryrun(Path(args.out_dir), args.epoch_interval, args.timeout)
+        _dryrun(
+            Path(args.out_dir),
+            args.epoch_interval,
+            args.timeout,
+            seed_slo_violation=args.seed_slo_violation,
+        )
     )
 
 

@@ -3,8 +3,9 @@
 The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
 ``LADDER_r*.json``, since ISSUE 7 the ingest-storm rounds
 ``INGEST_r*.json``, since ISSUE 9 the multichip comm rounds
-``MULTICHIP_r*.json``, and since ISSUE 10 the proving-plane rounds
-``PROVER_r*.json``) but nothing ever *read* the series — a PR could
+``MULTICHIP_r*.json``, since ISSUE 10 the proving-plane rounds
+``PROVER_r*.json``, and since ISSUE 11 the fleet-observability rounds
+``OBS_r*.json``) but nothing ever *read* the series — a PR could
 halve headline throughput and no gate would notice.  This tool closes
 the loop: it parses the recorded rounds into per-metric series
 (headline convergence seconds, cold/steady-state epoch seconds, plan
@@ -55,6 +56,13 @@ _FIELDS = {
     # and sustained proof throughput under the churned epoch replay.
     "p99_proof_lag_ms": True,
     "sustained_proofs_per_s": False,
+    # Fleet-observability rounds (OBS_r*.json): end-to-end freshness
+    # (attestation accepted → proof landed for the including epoch) and
+    # the lineage+SLO instrumentation overhead against the steady-state
+    # epoch — a regressing observability plane fails the gate like any
+    # other hot path.
+    "freshness_p99_ms": True,
+    "obs_overhead_pct": True,
     # Pass-8 comm scrape (MULTICHIP/LADDER rounds): per-iteration
     # collective wire volume of the sharded composites — a partitioner
     # surprise that inflates traffic regresses this series upward.
@@ -238,7 +246,8 @@ def main(argv: list[str] | None = None) -> int:
         action="append",
         default=None,
         help="history filename glob(s); default: BENCH_r*.json, "
-        "LADDER_r*.json, INGEST_r*.json, and MULTICHIP_r*.json",
+        "LADDER_r*.json, INGEST_r*.json, MULTICHIP_r*.json, "
+        "PROVER_r*.json, and OBS_r*.json",
     )
     ap.add_argument(
         "--fresh",
@@ -263,6 +272,7 @@ def main(argv: list[str] | None = None) -> int:
         "INGEST_r*.json",
         "MULTICHIP_r*.json",
         "PROVER_r*.json",
+        "OBS_r*.json",
     ]
     paths = [
         Path(p) for pat in patterns for p in globlib.glob(str(root / pat))
