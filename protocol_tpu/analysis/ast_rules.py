@@ -90,6 +90,26 @@ Pass 10 — the queue-observability rule (ISSUE 11):
   rings (``deque(maxlen=...)``) are excluded — they overwrite, never
   exert backpressure.
 
+Pass 11 — the durability rules (ISSUE 14):
+
+- ``non-atomic-state-write`` (error): a state-file write in ``node/``
+  outside the sanctioned shapes — ``open()``/``os.fdopen()`` with a
+  write/append mode, ``.write_text()``, or ``.write_bytes()`` in a
+  function that is neither the checkpoint store's ``_atomic_write``
+  helper (tmp + fsync + rename) nor fsync-disciplined (no ``fsync``
+  call in the same function, the WAL's append path shape).  A bare
+  ``open(path, "w")`` can be torn by a crash mid-write and the next
+  boot reads garbage; durable node state goes through the atomic
+  helper or carries its own fsync.
+- ``fault-point-in-jit`` (error): a chaos hook (``chaos.fire`` /
+  ``chaos.corrupt`` / ``chaos.wrap_file`` or any chaos-named
+  receiver) inside a jit- or shard_map-traced function.  Under a
+  trace the hook fires once at trace time and never again — the
+  schedule silently stops covering that point — and a callback-shaped
+  rewrite would smuggle a host sync into the kernel.  Fault points
+  live at host boundaries, the same doctrine as spans (pass 3) and
+  journal writes (pass 5).
+
 Pass 9 — the proving-plane boundary rule (ISSUE 10):
 
 - ``blocking-prove-in-epoch-loop`` (error): a synchronous prover
@@ -380,6 +400,55 @@ def _is_depth_gauge_write(node: ast.Call, name: str | None) -> bool:
     return False
 
 
+#: Chaos hook entry points (pass 11): host-boundary-only, like spans.
+_CHAOS_LEAVES = frozenset({"fire", "corrupt", "wrap_file"})
+
+
+def _is_chaos_call(name: str | None) -> bool:
+    """``chaos.fire(...)`` / ``CHAOS.corrupt(...)`` / any
+    chaos-named receiver calling a hook leaf."""
+    if name is None or "." not in name:
+        return False
+    receiver, leaf = name.rsplit(".", 1)
+    if leaf not in _CHAOS_LEAVES:
+        return False
+    return "chaos" in receiver.rsplit(".", 1)[-1].lower()
+
+
+#: File-write entry points the non-atomic-state-write rule tracks
+#: (pass 11).  ``.write()`` on an already-open handle is exempt — the
+#: open is the decision point.
+_WRITE_OPENERS = frozenset({"open", "os.fdopen", "io.open", "gzip.open"})
+_WRITE_METHOD_LEAVES = frozenset({"write_text", "write_bytes"})
+_WRITE_MODES = frozenset("wax+")
+
+
+def _is_state_write_call(node: ast.Call, name: str | None) -> bool:
+    """An ``open()``-family call with a write/append/create mode, or a
+    pathlib ``.write_text()``/``.write_bytes()``."""
+    if name is None:
+        return False
+    if name.rsplit(".", 1)[-1] in _WRITE_METHOD_LEAVES and isinstance(
+        node.func, ast.Attribute
+    ):
+        return True
+    if name not in _WRITE_OPENERS:
+        return False
+    mode: ast.expr | None = node.args[1] if len(node.args) >= 2 else None
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (
+        isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and bool(set(mode.value) & _WRITE_MODES)
+    )
+
+
+def _is_fsync_call(name: str | None) -> bool:
+    return name is not None and name.rsplit(".", 1)[-1] == "fsync"
+
+
 def _is_span_call(name: str | None) -> bool:
     """obs span entry points (``TRACER.span``/``TRACER.epoch`` or any
     ``*.span(...)``) — host boundaries by definition, so inside a
@@ -396,11 +465,18 @@ class _Visitor(ast.NodeVisitor):
         hot: bool,
         kernel_tree: bool = False,
         epoch_loop: bool = False,
+        node_tree: bool = False,
     ) -> None:
         self.rel_path = rel_path
         self.hot = hot
         self.kernel_tree = kernel_tree
         self.epoch_loop = epoch_loop
+        self.node_tree = node_tree
+        #: Pass-11 per-function state: write sites collected until the
+        #: function closes, when the _atomic_write/fsync exemptions
+        #: resolve (the discipline lives in the same function as the
+        #: open, by doctrine).
+        self._fn_frames: list[dict] = []
         self.jit_depth = 0
         #: Depth inside jit- OR shard_map-decorated functions (pass 3):
         #: shard_map bodies are traced exactly like jit bodies.
@@ -435,10 +511,31 @@ class _Visitor(ast.NodeVisitor):
         self.fn_depth += 1
         self.jit_depth += 1 if jitted else 0
         self.traced_depth += 1 if traced else 0
+        self._fn_frames.append({"name": node.name, "writes": [], "fsync": False})
         self.generic_visit(node)
+        frame = self._fn_frames.pop()
+        if (
+            frame["writes"]
+            and not frame["name"].startswith("_atomic_write")
+            and not frame["fsync"]
+        ):
+            for site in frame["writes"]:
+                self._emit_state_write(site)
         self.traced_depth -= 1 if traced else 0
         self.jit_depth -= 1 if jitted else 0
         self.fn_depth -= 1
+
+    def _emit_state_write(self, site: ast.AST) -> None:
+        self._emit(
+            "non-atomic-state-write",
+            "state-file write in node/ outside the _atomic_write helper "
+            "and without fsync discipline in the same function — a crash "
+            "mid-write tears the file and the next boot reads garbage; "
+            "route durable state through CheckpointStore._atomic_write "
+            "(tmp + fsync + rename) or fsync what you append "
+            "(node/wal.py)",
+            site,
+        )
 
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
@@ -453,6 +550,17 @@ class _Visitor(ast.NodeVisitor):
             self.bounded_queue_sites.append(node)
         elif _is_depth_gauge_write(node, name):
             self.has_depth_gauge = True
+        if self.node_tree:
+            # Pass 11 bookkeeping: write sites vs the enclosing
+            # function's fsync discipline (resolved at function close;
+            # module-scope writes have no exemption to wait for).
+            if _is_fsync_call(name) and self._fn_frames:
+                self._fn_frames[-1]["fsync"] = True
+            elif _is_state_write_call(node, name):
+                if self._fn_frames:
+                    self._fn_frames[-1]["writes"].append(node)
+                else:
+                    self._emit_state_write(node)
         if self.jit_depth > 0:
             if name is not None:
                 root = name.split(".", 1)[0]
@@ -510,6 +618,17 @@ class _Visitor(ast.NodeVisitor):
                     "trace time and never again — flight-recorder writes "
                     "belong at host boundaries (epoch tick, ingest, "
                     "pipeline), never in traced code",
+                    node,
+                )
+            elif _is_chaos_call(name):
+                self._emit(
+                    "fault-point-in-jit",
+                    f"{name}() inside a traced function fires once at "
+                    "trace time and never again — the chaos schedule "
+                    "silently stops covering this point, and a callback "
+                    "rewrite would smuggle a host sync into the kernel; "
+                    "fault points live at host boundaries (pass 3/5 "
+                    "doctrine)",
                     node,
                 )
             elif _is_plan_mutation_call(name):
@@ -655,6 +774,7 @@ def scan_source(source: str, rel_path: str) -> list[Finding]:
         hot=_is_hot(rel_path),
         kernel_tree=_in_tree(rel_path, KERNEL_TREES),
         epoch_loop=rel_path in EPOCH_LOOP_FILES,
+        node_tree=_in_tree(rel_path, ("node",)),
     )
     visitor.visit(tree)
     if visitor.bounded_queue_sites and not visitor.has_depth_gauge:

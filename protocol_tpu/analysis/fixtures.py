@@ -318,6 +318,48 @@ def _unobserved_queue() -> tuple[str, str]:
     return _UNOBSERVED_QUEUE_SRC, "protocol_tpu/ingest/_fixture_unobserved_queue.py"
 
 
+_NON_ATOMIC_STATE_WRITE_SRC = '''\
+import json
+
+
+def persist_cursor(path, cursor):
+    # Durable node state through a bare open(): a crash mid-write tears
+    # the file and the next boot reads garbage — the checkpoint store's
+    # _atomic_write (tmp + fsync + rename) is the sanctioned shape, or
+    # an append path that fsyncs what it wrote (node/wal.py).
+    with open(path, "w") as f:  # VIOLATION: non-atomic-state-write
+        json.dump({"cursor": cursor}, f)
+'''
+
+
+def _non_atomic_state_write() -> tuple[str, str]:
+    # The fake path lands in node/ so the tree-scoped pass-11 rule
+    # applies exactly as it would to real node state code.
+    return _NON_ATOMIC_STATE_WRITE_SRC, "protocol_tpu/node/_fixture_state_write.py"
+
+
+_FAULT_POINT_IN_JIT_SRC = '''\
+import jax
+
+from protocol_tpu import chaos
+
+
+@jax.jit
+def step(t):
+    # Under a trace this hook fires ONCE at trace time and never again:
+    # the chaos schedule silently stops covering the point, and a
+    # callback-shaped rewrite would smuggle a host sync into the hot
+    # loop — fault points live at host boundaries, like spans and
+    # journal writes.
+    chaos.fire("epoch.post_converge")  # VIOLATION: fault-point-in-jit
+    return t * 2.0
+'''
+
+
+def _fault_point_in_jit() -> tuple[str, str]:
+    return _FAULT_POINT_IN_JIT_SRC, "protocol_tpu/trust/_fixture_chaos_in_jit.py"
+
+
 #: Pass-7 seeded violations (whole-program concurrency rules).  Each
 #: source is a self-contained "program": it declares its own thread
 #: roots, so the analyzer's reachability machinery runs exactly as it
@@ -709,6 +751,14 @@ FIXTURES: dict[str, Fixture] = {
         Fixture(
             "unobserved-queue", "unobserved-queue",
             _unobserved_queue, "unobserved-queue", kind="ast",
+        ),
+        Fixture(
+            "non-atomic-state-write", "non-atomic-state-write",
+            _non_atomic_state_write, "non-atomic-state-write", kind="ast",
+        ),
+        Fixture(
+            "fault-point-in-jit", "fault-point-in-jit",
+            _fault_point_in_jit, "fault-point-in-jit", kind="ast",
         ),
         Fixture(
             "unguarded-shared-attr", "unguarded-shared-attr",

@@ -27,6 +27,29 @@ class ProtocolConfig:
     trust_backend: str = "native-cpu"
     event_fixture: str | None = None
     checkpoint_dir: str | None = None
+    #: Write-ahead attestation log (node/wal.py): every accepted
+    #: attestation is fsync'd to a size-rotated segment log before its
+    #: ingest verdict returns, and boot recovery replays the tail past
+    #: the newest valid checkpoint — ``kill -9`` at any instruction
+    #: loses nothing acknowledged.  Requires ``checkpoint_dir`` (the
+    #: log lives beside the snapshots); ``false`` restores the
+    #: checkpoint-only (lossy between snapshots) behavior.
+    wal: bool = True
+    #: WAL directory override; default ``<checkpoint_dir>/wal``.
+    wal_dir: str | None = None
+    #: Segment rotation threshold — with per-checkpoint truncation this
+    #: bounds WAL disk to roughly one epoch of traffic per retained
+    #: snapshot.
+    wal_segment_bytes: int = 4 << 20
+    #: fsync on every durability boundary (per verdict / per verify
+    #: batch).  Disable only for tests and benchmarks.
+    wal_fsync: bool = True
+    #: Fault-injection schedule (protocol_tpu/chaos/): a spec dict, an
+    #: ``@path`` reference, or None (disabled — the hot-path cost of
+    #: disabled chaos is one module-attribute read).  The
+    #: PROTOCOL_TPU_CHAOS env var takes precedence; only chaos tooling
+    #: (tools/crash_matrix.py, tests) should ever set either.
+    chaos: dict | str | None = None
     #: Double-buffered epoch pipeline (node/pipeline.py): overlap the
     #: next epoch's host stages (ingest drain, graph build, plan delta)
     #: with the current epoch's device converge + proving, behind a
@@ -141,6 +164,13 @@ class ProtocolConfig:
         cfg.trust_backend = obj.get("trust_backend", cfg.trust_backend)
         cfg.event_fixture = obj.get("event_fixture", cfg.event_fixture)
         cfg.checkpoint_dir = obj.get("checkpoint_dir", cfg.checkpoint_dir)
+        cfg.wal = bool(obj.get("wal", cfg.wal))
+        cfg.wal_dir = obj.get("wal_dir", cfg.wal_dir)
+        cfg.wal_segment_bytes = int(
+            obj.get("wal_segment_bytes", cfg.wal_segment_bytes)
+        )
+        cfg.wal_fsync = bool(obj.get("wal_fsync", cfg.wal_fsync))
+        cfg.chaos = obj.get("chaos", cfg.chaos)
         cfg.epoch_pipeline = bool(obj.get("epoch_pipeline", cfg.epoch_pipeline))
         cfg.warm_start = bool(obj.get("warm_start", cfg.warm_start))
         cfg.plan_delta_max_churn = float(

@@ -14,11 +14,20 @@ sources implement that here:
 from __future__ import annotations
 
 import json
+import logging
+import random
 from dataclasses import dataclass
 from pathlib import Path
-from typing import AsyncIterator, Iterator
+from typing import AsyncIterator, Callable, Iterator
 
+from .. import chaos
 from ..crypto.keccak import event_topic
+from ..obs.metrics import RPC_RETRIES
+
+log = logging.getLogger(__name__)
+
+chaos.declare("rpc.block_number", "chain head poll about to hit the RPC backend")
+chaos.declare("rpc.get_logs", "event-log fetch about to hit the RPC backend")
 
 #: keccak256("AttestationCreated(address,address,bytes32,bytes)") — the
 #: event topic emitted by AttestationStation.sol:13-18.
@@ -96,6 +105,20 @@ class FixtureEventSource:
             await asyncio.sleep(poll_interval)
 
 
+@dataclass(frozen=True)
+class RetryPolicy:
+    """The RPC retry wall's knobs: exponential backoff (full jitter)
+    with a per-call timeout.  A transient transport failure becomes a
+    counted retry (``eigentrust_rpc_retries_total{op}``) and a pause,
+    never a dead event loop — the node's only peer-to-peer transport
+    must survive an RPC endpoint that flaps for hours."""
+
+    base_s: float = 0.5
+    cap_s: float = 30.0
+    #: Per-call deadline: a hung endpoint is a retry, not a stall.
+    timeout_s: float = 10.0
+
+
 class ChainEventSource:
     """AttestationCreated replay/stream over an abstract RPC backend —
     the ethers-equivalent of server/src/ethereum.rs, with the transport
@@ -107,23 +130,39 @@ class ChainEventSource:
     ``block_number() -> int`` and
     ``get_logs(address, from_block, to_block, topic0) -> iterable`` of
     logs with ``topics: list[int]`` and ``data: bytes``.
+
+    ``stream`` wraps both behind the retry wall (:class:`RetryPolicy`)
+    and supports a **resumable block cursor**: pass ``cursor`` (the
+    next block to fetch, persisted in the checkpoint manifest by the
+    node) and ``on_advance`` to be told each time the cursor moves, so
+    a restart resumes the replay where it left off instead of from
+    block 0.
     """
 
-    def __init__(self, rpc, contract_address: str):
+    def __init__(self, rpc, contract_address: str, retry: RetryPolicy | None = None):
         self._rpc = rpc
         self.contract_address = contract_address
+        self.retry = retry or RetryPolicy()
+        self._rng = random.Random()
 
     def replay(
         self, from_block: int = 0, to_block=None
     ) -> Iterator[AttestationCreatedEvent]:
+        if chaos.ACTIVE:
+            chaos.fire("rpc.get_logs")
         logs = self._rpc.get_logs(
             address=int(self.contract_address, 16),
             from_block=from_block,
             to_block=to_block,
             topic0=int(ATTESTATION_CREATED_TOPIC, 16),
         )
-        for log in logs:
-            yield self._decode(log)
+        for log_ in logs:
+            yield self._decode(log_)
+
+    def _block_number(self) -> int:
+        if chaos.ACTIVE:
+            chaos.fire("rpc.block_number")
+        return self._rpc.block_number()
 
     @staticmethod
     def _decode(log) -> AttestationCreatedEvent:
@@ -138,20 +177,62 @@ class ChainEventSource:
             val=data[64 : 64 + length],
         )
 
-    async def stream(
-        self, poll_interval: float = 2.0
-    ) -> AsyncIterator[AttestationCreatedEvent]:
-        """Replay from block 0 (server/src/main.rs:139-143) then poll new
-        blocks — the ethers event-stream analog over plain JSON-RPC."""
+    async def _call(self, op: str, fn: Callable):
+        """One RPC call off-loop with the policy's per-call deadline —
+        a sync transport (web3, the dev chain) must never park the
+        node's event loop, and a hung one must become a retry."""
         import asyncio
 
-        next_block = 0
+        return await asyncio.wait_for(
+            asyncio.get_running_loop().run_in_executor(None, fn),
+            timeout=self.retry.timeout_s,
+        )
+
+    async def stream(
+        self,
+        poll_interval: float = 2.0,
+        *,
+        cursor: int | None = None,
+        on_advance: Callable[[int], None] | None = None,
+    ) -> AsyncIterator[AttestationCreatedEvent]:
+        """Replay from the cursor (default block 0,
+        server/src/main.rs:139-143) then poll new blocks — the ethers
+        event-stream analog over plain JSON-RPC, behind the retry
+        wall: every ``block_number``/``get_logs`` failure or timeout
+        backs off exponentially with full jitter, counted on
+        ``eigentrust_rpc_retries_total{op}``, and the stream resumes
+        from the last *delivered* block so no event is skipped."""
+        import asyncio
+
+        next_block = int(cursor) if cursor is not None else 0
+        backoff = self.retry.base_s
         while True:
-            head = self._rpc.block_number()
-            if head >= next_block:
-                for ev in self.replay(from_block=next_block, to_block=head):
-                    yield ev
-                next_block = head + 1
+            op = "block_number"
+            try:
+                head = await self._call(op, self._block_number)
+                if head >= next_block:
+                    op = "get_logs"
+                    lo, hi = next_block, head
+                    events = await self._call(
+                        op, lambda: list(self.replay(from_block=lo, to_block=hi))
+                    )
+                    for ev in events:
+                        yield ev
+                    next_block = head + 1
+                    if on_advance is not None:
+                        on_advance(next_block)
+            except (asyncio.CancelledError, GeneratorExit):
+                raise
+            except Exception as exc:  # noqa: BLE001 - the retry wall's whole job
+                RPC_RETRIES.inc(op=op)
+                delay = self._rng.uniform(0, backoff)
+                log.warning(
+                    "chain rpc %s failed (%r); retrying in %.2fs", op, exc, delay
+                )
+                await asyncio.sleep(delay)
+                backoff = min(backoff * 2, self.retry.cap_s)
+                continue
+            backoff = self.retry.base_s
             await asyncio.sleep(poll_interval)
 
 

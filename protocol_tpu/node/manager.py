@@ -21,6 +21,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from .. import chaos
 from ..analysis.budget import COMM_INVARIANTS, KERNEL_INVARIANTS, NON_JAX_BACKENDS
 from ..crypto import calculate_message_hash, group_pks_hash, message_hash_batch
 from ..crypto.eddsa import PublicKey, sign, verify as verify_sig
@@ -35,12 +36,16 @@ from ..trust.backend import ConvergenceResult, get_backend
 from ..trust.graph import TrustGraph
 from ..trust.native import power_iterate
 from ..zk.proof import PoseidonCommitmentProver, Proof, Prover
-from .attestation import Attestation
+from .attestation import Attestation, AttestationData
 from .bootstrap import FIXED_SET, INITIAL_SCORE, NUM_ITER, NUM_NEIGHBOURS, SCALE, keyset_from_raw
 from .epoch import Epoch
 from .errors import EigenError
 
 logger = logging.getLogger(__name__)
+
+chaos.declare("ingest.pre_apply", "an accepted attestation about to enter the cache/WAL")
+chaos.declare("epoch.post_converge", "the fixed point landed, before state publish")
+chaos.declare("prover.pre_enqueue", "the epoch proof about to be computed or enqueued")
 
 
 @dataclass
@@ -121,6 +126,10 @@ class PreparedEpoch:
     #: the manager's dirty set only after a successful converge, so a
     #: failed epoch leaves the churn accounting intact.
     dirty_snapshot: set[int]
+    #: WAL applied-watermark read *before* graph assembly: every log
+    #: record ≤ it is in this graph, so the epoch's checkpoint may
+    #: truncate the WAL through it.  None when no WAL is attached.
+    wal_seq: int | None = None
 
 
 class Manager:
@@ -155,6 +164,16 @@ class Manager:
         #: boot, so warm start survives restart).
         self.last_scores: np.ndarray | None = None
         self.last_peer_hashes: list[int] | None = None
+        #: Write-ahead attestation log (node/wal.py), attached by boot
+        #: recovery AFTER the tail replay (so replay never re-appends).
+        #: Accessed bare from every ingest root — attachment is a
+        #: single reference publish, same GIL discipline as the
+        #: attestation cache itself.
+        self.wal = None
+        #: WAL watermark of the last landed epoch — published with the
+        #: warm-start pair under the state lock; the checkpoint
+        #: truncates the log through it.
+        self.last_wal_seq: int | None = None
         #: Guards the cross-epoch mutable state shared between the
         #: pipeline's host stage (prepare_epoch on the submit thread),
         #: the device stage (converge_prepared on the worker thread),
@@ -272,18 +291,67 @@ class Manager:
         path raising where the other returns."""
         return self.add_attestations_bulk([att])[0]
 
-    def apply_verified(self, att: Attestation) -> IngestResult:
+    def apply_verified(
+        self, att: Attestation, raw: bytes | None = None, *, flush: bool = True
+    ) -> IngestResult:
         """Cache an attestation whose structural AND signature checks
         already passed upstream — the admission plane's apply stage
-        (ingest/plane.py): verification happened in a worker process,
-        so all that's left here is the (memoized) pk hash and two
-        GIL-atomic dict writes."""
+        (ingest/plane.py) and the WAL replay path (node/wal.py).  With
+        a WAL attached the record is appended (and, with ``flush``,
+        fsync'd) BEFORE the cache insert: an acknowledged attestation
+        survives ``kill -9`` at any instruction after this returns.
+        ``raw`` is the wire payload when the caller already has it
+        (skips re-serialization); batch callers pass ``flush=False``
+        and call :meth:`flush_wal` once per batch."""
+        if chaos.ACTIVE:
+            chaos.fire("ingest.pre_apply")
         h = self._pk_hash(att.pk)
+        seq = None
+        if self.wal is not None:
+            from .wal import encode_payload
+
+            if raw is None:
+                raw = AttestationData.from_attestation(att).to_bytes()
+            # An OSError here (disk full, injected fault) propagates:
+            # without the log record the attestation must NOT be
+            # acknowledged — the plane maps it to reason="wal-error".
+            seq = self.wal.append(
+                encode_payload(len(att.neighbours), raw), flush=flush
+            )
         self.attestations[h] = att
+        if seq is not None:
+            self.wal.mark_applied(seq)
         with self._state_lock:
             self._dirty_hashes.add(h)
         obs_metrics.ATTESTATIONS_ACCEPTED.inc()
         return IngestResult(True)
+
+    def flush_wal(self) -> None:
+        """Force buffered WAL records to disk — the batch-granular
+        durability boundary (the admission plane calls this once per
+        verify batch, before resolving the batch's verdicts)."""
+        if self.wal is not None:
+            self.wal.flush()
+
+    def snapshot_attestations(self) -> list[tuple[int, bytes]]:
+        """The cache as ``(num_neighbours, wire bytes)`` rows for the
+        checkpoint: the graph column alone cannot reconstruct the cache
+        post-recovery (epochs rebuild the graph FROM it), and the WAL
+        only retains the tail past the checkpointed watermark."""
+        return [
+            (len(att.neighbours), AttestationData.from_attestation(att).to_bytes())
+            for att in list(self.attestations.values())
+        ]
+
+    def restore_attestation(self, att: Attestation) -> None:
+        """Re-install one checkpointed attestation at boot: cache
+        insert + dirty mark only — no WAL append (it is already inside
+        the snapshot's watermark), no accept metrics (it was counted
+        when first accepted), no chaos hook."""
+        h = self._pk_hash(att.pk)
+        self.attestations[h] = att
+        with self._state_lock:
+            self._dirty_hashes.add(h)
 
     def add_attestations_bulk(self, atts: list[Attestation]) -> list[IngestResult]:
         """High-throughput ingest for event replay: run the shared
@@ -337,16 +405,22 @@ class Manager:
 
             for (i, att, _), ok in zip(candidates, sig_ok):
                 if ok:
-                    h = self._pk_hash(att.pk)
-                    self.attestations[h] = att
-                    with self._state_lock:
-                        self._dirty_hashes.add(h)
-                    results[i] = IngestResult(True)
-                    obs_metrics.ATTESTATIONS_ACCEPTED.inc()
+                    try:
+                        # The shared accept path: WAL append (buffered;
+                        # one fsync per bulk call below) + cache insert.
+                        results[i] = self.apply_verified(att, flush=False)
+                    except OSError as exc:
+                        results[i] = IngestResult(False, "wal-error")
+                        obs_metrics.ATTESTATIONS_REJECTED.inc(reason="wal-error")
+                        JOURNAL.record(
+                            "ingest-reject", reason="wal-error", error=repr(exc)
+                        )
                 else:
                     results[i] = IngestResult(False, "bad-signature")
                     obs_metrics.ATTESTATIONS_REJECTED.inc(reason="bad-signature")
                     JOURNAL.record("ingest-reject", reason="bad-signature")
+            # One fsync per bulk call: the verdicts below are durable.
+            self.flush_wal()
         return [r for r in results if r is not None]
 
     def get_attestation(self, pk: PublicKey) -> Attestation:
@@ -431,9 +505,17 @@ class Manager:
             pub_ins=list(pub_ins), proof=proof_bytes
         )
 
+    def checkpoint_watermark(self) -> int | None:
+        """WAL seq the next checkpoint may truncate through — the last
+        landed epoch's watermark, read as a pair with the warm state."""
+        with self._state_lock:
+            return self.last_wal_seq
+
     def calculate_proofs(self, epoch: Epoch) -> None:
         """Converge the fixed set exactly and cache a proof of the
         resulting public inputs (manager/mod.rs:170-214)."""
+        if chaos.ACTIVE:
+            chaos.fire("prover.pre_enqueue")
         cfg = self.config
         atts = [self.attestations[h] for h in self._group_hashes]
         ops = [list(a.scores) for a in atts]
@@ -545,6 +627,12 @@ class Manager:
         with self._state_lock:
             dirty = set(self._dirty_hashes)
             cached_plan = self.window_plan
+        # WAL watermark BEFORE assembly: every record at or below it is
+        # already in the cache, so it is inside the graph built next —
+        # the checkpoint of this epoch may truncate the log through it.
+        # (A record appended after this read stays in the WAL for the
+        # next epoch; supersets are safe, misses are not.)
+        wal_seq = self.wal.applied_watermark() if self.wal is not None else None
         with TRACER.span("build_graph"):
             graph = self.build_graph()
         # A concurrent build_graph (pipelined checkpoint path) may have
@@ -586,6 +674,7 @@ class Manager:
             t0=t0,
             delta_rows=delta_rows,
             dirty_snapshot=dirty,
+            wal_seq=wal_seq,
         )
 
     def converge_prepared(
@@ -632,6 +721,11 @@ class Manager:
             steady_state=steady_state,
             epoch=prepared.epoch.number,
         )
+        if chaos.ACTIVE:
+            # The fixed point exists but nothing is published yet — a
+            # crash here must recover every accepted attestation from
+            # checkpoint + WAL and reconverge to the same fixed point.
+            chaos.fire("epoch.post_converge")
         if prepared.t0 is not None:
             obs_metrics.WARM_START_APPLIED.inc()
         # The epoch landed: its churn is folded into the cached plan
@@ -645,6 +739,7 @@ class Manager:
             self.last_graph = graph
             self.last_scores = result.scores
             self.last_peer_hashes = prepared.id_order
+            self.last_wal_seq = prepared.wal_seq
         self.cached_results[prepared.epoch] = result
         # Convergence health → the /metrics surface: the iteration
         # count, the final residual, and the full device-captured

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 
 import json
 
+from .. import chaos
 from ..obs import (
     DRIFT,
     JOURNAL,
@@ -42,6 +43,8 @@ from .ethereum import FixtureEventSource
 from .manager import Manager, ManagerConfig
 
 log = logging.getLogger("protocol_tpu.node")
+
+chaos.declare("checkpoint.post_save", "snapshot landed, before the WAL truncates")
 
 BAD_REQUEST = 400
 NOT_FOUND = 404
@@ -116,13 +119,25 @@ def node_health(node: "Node | None") -> tuple[int, dict]:
         degraded.append("slo-violating")
 
     if node is not None:
+        # Boot recovery (node/wal.py): "recovering" while the WAL tail
+        # replays — the load balancer keeps the node out of rotation's
+        # hard-fail path but dashboards see exactly where boot is.
+        recovering = node._recovery.get("state") == "recovering"
+        components["recovery"] = dict(node._recovery)
+        if recovering:
+            degraded.append("recovering")
         ingest = node._ingest
         components["ingest"] = {
             "configured": bool(node.config.ingest_plane),
             "started": ingest is not None,
             "pending": ingest.stats()["pending"] if ingest is not None else None,
         }
-        if node.config.ingest_plane and ingest is None and node._server is not None:
+        if (
+            node.config.ingest_plane
+            and ingest is None
+            and node._server is not None
+            and not recovering
+        ):
             problems.append("ingest-plane-not-started")
         plane = node._prover_plane
         if plane is not None:
@@ -361,6 +376,18 @@ class Node:
     #: install into the Manager's cache from a dispatcher thread.
     #: None = the sequential prove-per-tick path.
     _prover_plane: object | None = field(default=None, repr=False)
+    #: Write-ahead attestation log (config.wal + checkpoint_dir); also
+    #: reachable as ``manager.wal`` once recovery attaches it.
+    _wal: object | None = field(default=None, repr=False)
+    #: Boot-recovery state machine surfaced as the /healthz
+    #: ``recovery`` component: ``disabled`` (no checkpoint dir),
+    #: ``recovering`` (checkpoint load + WAL replay in flight — the
+    #: HTTP socket is already up so the walk is scrapeable), ``ok``
+    #: (plus the recovery report: checkpoint epoch, fallbacks, records
+    #: replayed, seconds).
+    _recovery: dict = field(
+        default_factory=lambda: {"state": "disabled"}, repr=False
+    )
 
     @classmethod
     def from_config(cls, config: ProtocolConfig) -> "Node":
@@ -566,6 +593,8 @@ class Node:
                 self.manager.calculate_proofs(epoch)
             return
         with TRACER.span("prove_enqueue"):
+            if chaos.ACTIVE:
+                chaos.fire("prover.pre_enqueue")
             status = self._prover_plane.submit(self.manager.build_proof_job(epoch))
         log.info("epoch %s: proof job enqueued (state=%s)", epoch, status.state)
 
@@ -580,10 +609,17 @@ class Node:
 
         # Persist exactly the graph the scores were computed on
         # (ingest keeps mutating the attestation cache concurrently;
-        # a rebuilt graph could have more peers than scores).
-        graph = (
-            self.manager.last_graph if scores is not None else self.manager.build_graph()
-        )
+        # a rebuilt graph could have more peers than scores).  The WAL
+        # watermark pairs with the graph: for a converged epoch it is
+        # the one read before that graph's assembly; for the fixed-set
+        # path it is read before the fresh build below.
+        wal = self.manager.wal
+        if scores is not None:
+            graph = self.manager.last_graph
+            wal_seq = self.manager.checkpoint_watermark()
+        else:
+            wal_seq = wal.applied_watermark() if wal is not None else None
+            graph = self.manager.build_graph()
         # Async proving: the proof usually hasn't landed by checkpoint
         # time (that's the point) — snapshot without it; the proof is
         # re-derivable from the attestation stream and served from the
@@ -597,7 +633,8 @@ class Node:
         except EigenError:
             proof_json = None
         with TELEMETRY.timer("epoch.checkpoint"), TRACER.span("checkpoint"):
-            CheckpointStore(self.config.checkpoint_dir).save(
+            store = CheckpointStore(self.config.checkpoint_dir)
+            store.save(
                 epoch,
                 graph,
                 scores,
@@ -608,7 +645,27 @@ class Node:
                 peer_hashes=(
                     self.manager.last_peer_hashes if scores is not None else None
                 ),
+                wal_seq=wal_seq,
+                # The cache itself (senders' last wire rows): the
+                # recovery state graph columns can't reconstruct, and
+                # the truncated WAL no longer holds.  A superset of
+                # the graph's inputs is safe; the WAL tail replays the
+                # rest idempotently.
+                attestations=self.manager.snapshot_attestations(),
             )
+            if chaos.ACTIVE:
+                # Snapshot landed, WAL not yet truncated: a crash here
+                # must replay idempotently (the dedup'd cache absorbs
+                # re-applied records the snapshot already holds).
+                chaos.fire("checkpoint.post_save")
+            if wal is not None:
+                # Truncate through the OLDEST retained snapshot's
+                # watermark, not this epoch's: a torn latest snapshot
+                # falls back epoch by epoch, and the fallback target
+                # must still find every record it lacks in the log.
+                floor = store.retained_wal_floor()
+                if floor is not None:
+                    wal.truncate_through(floor)
 
     def _pipeline_device_stage(self, prepared):
         """Device half of a pipelined epoch: prove → converge (from the
@@ -713,10 +770,25 @@ class Node:
         return None
 
     async def _event_loop(self):
+        from .ethereum import ChainEventSource
+
         source = self._event_source()
         if source is None:
             return
-        async for event in source.stream():
+        stream_kwargs = {}
+        if isinstance(source, ChainEventSource) and self.config.checkpoint_dir:
+            # Resumable replay: the block cursor rides the checkpoint
+            # manifest, so a restart resumes the chain replay where it
+            # left off instead of from block 0 (the WAL already holds
+            # everything accepted since the last snapshot).
+            from .checkpoint import CheckpointStore
+
+            store = CheckpointStore(self.config.checkpoint_dir)
+            stream_kwargs = {
+                "cursor": store.block_cursor(),
+                "on_advance": store.save_block_cursor,
+            }
+        async for event in source.stream(**stream_kwargs):
             try:
                 from .attestation import AttestationData
 
@@ -753,39 +825,40 @@ class Node:
                 "rejected attestation event from %s: %s", creator, result.reason
             )
 
-    def _restore_checkpoint(self) -> None:
-        """Serve the last checkpointed proof immediately after restart;
-        the chain replay (the source of truth, main.rs:139-143) still
-        runs and overwrites as it catches up."""
-        from ..zk.proof import ProofRaw
-        from .checkpoint import CheckpointStore
+    def _wal_dir(self) -> str:
+        return self.config.wal_dir or f"{self.config.checkpoint_dir}/wal"
 
-        snapshot = CheckpointStore(self.config.checkpoint_dir).load_latest()
-        if snapshot is None:
-            return
-        if snapshot.proof_json:
-            proof = ProofRaw.from_json(snapshot.proof_json).to_proof()
-            self.manager.cached_proofs[snapshot.epoch] = proof
-        # Warm-start state: the checkpointed fixed point plus its
-        # peer-hash column, so the first epoch after reboot converges
-        # from near-fixed-point instead of cold (PERF.md §11).
-        # Published through the manager's state lock so a concurrently
-        # starting pipeline never sees a half-restored snapshot.
-        self.manager.restore_warm_state(
-            graph=snapshot.graph,
-            plan=snapshot.plan,
-            scores=snapshot.scores,
-            peer_hashes=snapshot.peer_hashes,
-        )
+    def _recover_state(self) -> None:
+        """Boot recovery (node/wal.py): newest *valid* checkpoint (torn
+        or corrupt snapshots fall back epoch by epoch) → warm state →
+        WAL tail replayed through ``apply_verified`` → WAL attached so
+        new accepts append.  Runs in an executor while the HTTP socket
+        already serves — /healthz reports the ``recovering`` component
+        state until this returns.  The chain replay (the source of
+        truth, main.rs:139-143) still runs afterwards, resuming from
+        the persisted block cursor, and overwrites as it catches up."""
+        from .checkpoint import CheckpointStore
+        from .wal import AttestationWAL, recover
+
+        store = CheckpointStore(self.config.checkpoint_dir)
+        wal = None
+        if self.config.wal:
+            wal = AttestationWAL(
+                self._wal_dir(),
+                segment_max_bytes=self.config.wal_segment_bytes,
+                fsync=self.config.wal_fsync,
+            )
+        report = recover(self.manager, store, wal)
+        self._wal = wal
+        self._recovery = {"state": "ok", **report}
         log.info(
-            "restored checkpoint: epoch %s, %d peers%s%s%s",
-            snapshot.epoch,
-            snapshot.graph.n,
-            ", proof available" if snapshot.proof_json else "",
-            ", windowed plan restored" if snapshot.plan is not None else "",
-            ", warm-start scores restored"
-            if snapshot.scores is not None and snapshot.peer_hashes is not None
-            else "",
+            "recovered: checkpoint epoch %s (%d fallback(s)), %d WAL "
+            "record(s) replayed (%d torn-tail dropped) in %.3fs",
+            report["checkpoint_epoch"],
+            report["checkpoint_fallbacks"],
+            report["wal_replayed"],
+            report["wal_dropped_tail"],
+            report["seconds"],
         )
 
     def _flight_dump_path(self) -> str:
@@ -806,6 +879,10 @@ class Node:
     async def start(self) -> None:
         if self.config.journal_path:
             JOURNAL.configure(self.config.journal_path)
+        # Fault-injection schedule (chaos tooling only): the env var
+        # wins — it is how the crash matrix drives a node it spawns.
+        if self.config.chaos and not chaos.ACTIVE:
+            chaos.configure(self.config.chaos)
         # Fleet-plane boot: lineage sampling period and the standing
         # SLO objectives (cadence target derives from the configured
         # epoch interval).
@@ -834,9 +911,22 @@ class Node:
             )
         except (NotImplementedError, RuntimeError, ValueError):
             pass
-        if self.config.checkpoint_dir:
-            self._restore_checkpoint()
+        # The HTTP socket comes up BEFORE recovery so /healthz can
+        # report the walk: recovering (checkpoint load + WAL replay in
+        # an executor, the loop stays responsive) → ok.  The epoch and
+        # event loops start strictly after recovery lands.
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.config.host, self.config.port
+        )
+        # Initial self-attestations first: the WAL replay below then
+        # overwrites any fixed-set row with the newer accepted state
+        # (never the reverse — recovery must not resurrect defaults).
         self.manager.generate_initial_attestations()
+        if self.config.checkpoint_dir:
+            self._recovery = {"state": "recovering"}
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._recover_state
+            )
         if self.config.ingest_plane:
             from ..ingest import IngestPlane, IngestPlaneConfig
             from ..ingest.ratelimit import RateLimitConfig
@@ -916,9 +1006,6 @@ class Node:
         warm = asyncio.get_running_loop().run_in_executor(
             None, self.manager.warm_prover
         )
-        self._server = await asyncio.start_server(
-            self._handle_conn, self.config.host, self.config.port
-        )
         self._tasks = [
             asyncio.create_task(self._epoch_loop(warm)),
             asyncio.create_task(self._event_loop()),
@@ -950,6 +1037,10 @@ class Node:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+        if self._wal is not None:
+            # Seal the active segment (flush + rotate) — a clean stop
+            # leaves no unflushed tail for the next boot to drop.
+            self._wal.close()
         # Flush the journal's pending batch so the on-disk JSONL is
         # complete through the stop (the ring itself stays queryable).
         JOURNAL.flush()

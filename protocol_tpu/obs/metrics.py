@@ -559,6 +559,36 @@ WORKER_SNAPSHOT_MERGES = METRICS.counter(
     "boundary and merged into the fleet aggregator, by pool",
     labelnames=("pool",),
 )
+WAL_APPENDED = METRICS.counter(
+    "eigentrust_wal_appended_total",
+    "Attestation records appended to the write-ahead log (every "
+    "accepted attestation lands here before its ingest verdict "
+    "returns — the crash-consistency boundary, node/wal.py)",
+)
+WAL_REPLAYED = METRICS.counter(
+    "eigentrust_wal_replayed_total",
+    "WAL records re-applied through the apply_verified fast path "
+    "during boot recovery (the tail past the newest valid "
+    "checkpoint's wal_seq watermark)",
+)
+CHECKPOINT_FALLBACKS = METRICS.counter(
+    "eigentrust_checkpoint_fallbacks_total",
+    "Snapshots skipped during load because they were torn, corrupt "
+    "(per-column sha256 mismatch), or unreadable — recovery fell back "
+    "to the previous epoch (journaled with the failure)",
+)
+RECOVERY_SECONDS = METRICS.gauge(
+    "eigentrust_recovery_seconds",
+    "Wall-clock of the last boot recovery (checkpoint load + warm "
+    "state restore + WAL tail replay); /healthz reports component "
+    "state recovering while this is in progress",
+)
+RPC_RETRIES = METRICS.counter(
+    "eigentrust_rpc_retries_total",
+    "Chain RPC calls retried by the event-stream retry wall "
+    "(exponential backoff + jitter + per-call timeout), by operation",
+    labelnames=("op",),
+)
 LOCK_WAIT_SECONDS = METRICS.histogram(
     "eigentrust_lock_wait_seconds",
     "Lock-acquisition wait time by allocation site — recorded only "
@@ -628,5 +658,10 @@ __all__ = [
     "HEALTH_STATUS",
     "FLEET_SOURCES",
     "WORKER_SNAPSHOT_MERGES",
+    "WAL_APPENDED",
+    "WAL_REPLAYED",
+    "CHECKPOINT_FALLBACKS",
+    "RECOVERY_SECONDS",
+    "RPC_RETRIES",
     "LOCK_WAIT_SECONDS",
 ]

@@ -19,7 +19,9 @@ python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.
 # enumerated waiver table; pass 8 is the SPMD-lowering comm analyzer
 # (compiles every backend under the 8-device CPU mesh and checks the
 # partitioner's collectives/bytes/aliasing against COMM_INVARIANTS,
-# sharded composites at two problem scales).  Any error-severity
+# sharded composites at two problem scales); pass 11 is the durability
+# ruleset (non-atomic state writes in node/, chaos fault points inside
+# jit/shard_map bodies).  Any error-severity
 # finding — including an unwaived concurrency/comm finding or a STALE
 # waiver in either table — fails here.  Emits ANALYSIS.json (uploaded
 # as a CI artifact; the concurrency and comm sections carry the root
@@ -35,7 +37,7 @@ python -m protocol_tpu.analysis --output ANALYSIS.json
 # (ISSUE 7); prover/ with the async proving plane (ISSUE 10) — the
 # whole admission + proving + serving + instrumentation path sits
 # behind the same wall as the kernels.
-HARD_TREES="protocol_tpu/ops protocol_tpu/trust protocol_tpu/parallel protocol_tpu/node protocol_tpu/analysis protocol_tpu/obs protocol_tpu/crypto protocol_tpu/zk protocol_tpu/ingest protocol_tpu/prover"
+HARD_TREES="protocol_tpu/ops protocol_tpu/trust protocol_tpu/parallel protocol_tpu/node protocol_tpu/analysis protocol_tpu/obs protocol_tpu/crypto protocol_tpu/zk protocol_tpu/ingest protocol_tpu/prover protocol_tpu/chaos"
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check .

@@ -905,3 +905,178 @@ class TestUnobservedQueueRule:
             assert [
                 f for f in findings if f.rule == "unobserved-queue"
             ] == [], rel
+
+
+class TestNonAtomicStateWriteRule:
+    """Pass 11 (ISSUE 14): durable node state must go through the
+    checkpoint store's _atomic_write helper (tmp + fsync + rename) or
+    carry fsync discipline in the same function (the WAL's shape) —
+    a bare open()+write in node/ can be torn by a crash mid-write."""
+
+    def test_bare_open_write_in_node_fires(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/cursor.py",
+            "import json\n"
+            "def persist(path, cursor):\n"
+            "    with open(path, 'w') as f:\n"
+            "        json.dump({'cursor': cursor}, f)\n",
+        )
+        assert [f.rule for f in findings] == ["non-atomic-state-write"]
+        assert findings[0].file == "protocol_tpu/node/cursor.py"
+        assert findings[0].line == 3
+
+    def test_write_text_and_write_bytes_fire(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/state.py",
+            "def a(p):\n"
+            "    p.write_text('x')\n"
+            "def b(p):\n"
+            "    p.write_bytes(b'x')\n",
+        )
+        assert [f.rule for f in findings] == [
+            "non-atomic-state-write",
+            "non-atomic-state-write",
+        ]
+        assert [f.line for f in findings] == [2, 4]
+
+    def test_module_scope_write_fires(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/boot.py",
+            "open('/tmp/state', 'wb').write(b'x')\n",
+        )
+        assert [f.rule for f in findings] == ["non-atomic-state-write"]
+
+    def test_atomic_write_helper_is_exempt(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/store.py",
+            "import os, tempfile\n"
+            "def _atomic_write(dest, write_fn, mode):\n"
+            "    fd, tmp = tempfile.mkstemp()\n"
+            "    with os.fdopen(fd, mode) as f:\n"
+            "        write_fn(f)\n"
+            "        os.fsync(f.fileno())\n"
+            "    os.replace(tmp, dest)\n",
+        )
+        assert findings == []
+
+    def test_fsync_discipline_in_same_function_is_exempt(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/seglog.py",
+            "import os\n"
+            "def open_segment(path):\n"
+            "    f = open(path, 'wb')\n"
+            "    f.write(b'MAGIC')\n"
+            "    f.flush()\n"
+            "    os.fsync(f.fileno())\n"
+            "    return f\n",
+        )
+        assert findings == []
+
+    def test_reads_are_fine(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/reader.py",
+            "def load(path):\n"
+            "    with open(path) as f:\n"
+            "        a = f.read()\n"
+            "    with open(path, 'rb') as f:\n"
+            "        return a, f.read()\n",
+        )
+        assert findings == []
+
+    def test_same_code_outside_node_tree_is_fine(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/obs/export.py",
+            "def dump(path, text):\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write(text)\n",
+        )
+        assert findings == []
+
+    def test_seeded_fixture_registered(self):
+        assert "non-atomic-state-write" in FIXTURES
+        assert FIXTURES["non-atomic-state-write"].kind == "ast"
+
+    def test_real_node_tree_is_clean(self):
+        """checkpoint.py routes through _atomic_write, wal.py fsyncs
+        what it opens — the rule stays quiet on the live tree."""
+        root = FIXTURES_PATH.resolve().parents[2]
+        for path in sorted((root / "protocol_tpu" / "node").glob("*.py")):
+            findings = scan_file(path, root)
+            assert [
+                f for f in findings if f.rule == "non-atomic-state-write"
+            ] == [], path.name
+
+
+class TestFaultPointInJitRule:
+    """Pass 11 (ISSUE 14): chaos hooks are host-boundary-only — inside
+    traced code they fire once at trace time and the schedule silently
+    stops covering the point (the pass 3/5 host-callback doctrine)."""
+
+    def test_chaos_fire_in_jit_fires(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/kern.py",
+            "import jax\n"
+            "from protocol_tpu import chaos\n"
+            "@jax.jit\n"
+            "def step(t):\n"
+            "    chaos.fire('epoch.post_converge')\n"
+            "    return t * 2.0\n",
+        )
+        assert [f.rule for f in findings] == ["fault-point-in-jit"]
+        assert findings[0].line == 5
+
+    def test_chaos_corrupt_in_shard_map_fires(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/parallel/kern.py",
+            "from functools import partial\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "from protocol_tpu import chaos\n"
+            "@partial(shard_map, mesh=None, in_specs=None, out_specs=None)\n"
+            "def step(t):\n"
+            "    data = chaos.corrupt('wal.append', t)\n"
+            "    return data\n",
+        )
+        assert "fault-point-in-jit" in [f.rule for f in findings]
+
+    def test_host_boundary_chaos_is_fine(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/node/tick.py",
+            "from protocol_tpu import chaos\n"
+            "def epoch_tick(epoch):\n"
+            "    if chaos.ACTIVE:\n"
+            "        chaos.fire('epoch.post_converge')\n"
+            "    return epoch\n",
+        )
+        assert findings == []
+
+    def test_unrelated_fire_methods_are_fine(self, tmp_path):
+        findings = _scan(
+            tmp_path,
+            "protocol_tpu/trust/kern.py",
+            "import jax\n"
+            "@jax.jit\n"
+            "def step(t, trigger):\n"
+            "    trigger.fire(t)\n"
+            "    return t\n",
+        )
+        assert findings == []
+
+    def test_seeded_fixture_registered(self):
+        assert "fault-point-in-jit" in FIXTURES
+        assert FIXTURES["fault-point-in-jit"].kind == "ast"
+
+    def test_real_tree_is_clean_of_chaos_in_jit(self):
+        from protocol_tpu.analysis.ast_rules import run_ast_pass
+
+        findings, _ = run_ast_pass()
+        assert [f for f in findings if f.rule == "fault-point-in-jit"] == []

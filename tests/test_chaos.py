@@ -1,0 +1,220 @@
+"""Fault-injection framework (protocol_tpu/chaos/) — ISSUE 14.
+
+Covers: the disabled default (one module-attribute read, engine never
+touched), declarative registry enumeration, deterministic trigger
+semantics (after / times / seeded p), every fault kind (crash via a
+subprocess — the in-process tests can't survive ``os._exit`` — delay,
+io-error, rpc-error, torn writes through ``corrupt`` and
+``wrap_file``), counting mode, and env-var configuration.
+"""
+
+from __future__ import annotations
+
+import errno
+import io
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from protocol_tpu import chaos
+
+
+@pytest.fixture(autouse=True)
+def _reset_chaos():
+    yield
+    chaos.reset()
+
+
+class TestDisabledDefault:
+    def test_inactive_by_default(self):
+        assert chaos.ACTIVE is False
+
+    def test_fire_without_schedule_is_noop(self):
+        # The guarded call shape: sites never even reach fire() when
+        # inactive, but an unguarded call must still be harmless.
+        chaos.fire("wal.post_append")
+
+    def test_registry_lists_declared_points(self):
+        # Importing the node modules registers their fault points.
+        import protocol_tpu.node.checkpoint  # noqa: F401
+        import protocol_tpu.node.ethereum  # noqa: F401
+        import protocol_tpu.node.server  # noqa: F401
+        import protocol_tpu.node.wal  # noqa: F401
+
+        points = chaos.registry()
+        for expected in (
+            "wal.append",
+            "wal.post_append",
+            "wal.replay",
+            "checkpoint.write",
+            "checkpoint.pre_rename",
+            "checkpoint.post_save",
+            "ingest.pre_apply",
+            "epoch.post_converge",
+            "prover.pre_enqueue",
+            "rpc.get_logs",
+            "rpc.block_number",
+        ):
+            assert expected in points, expected
+
+
+class TestTriggers:
+    def test_after_fires_on_exact_hit(self):
+        chaos.configure(
+            {"seed": 1, "faults": [{"point": "p", "kind": "io-error", "after": 3}]}
+        )
+        chaos.fire("p")
+        chaos.fire("p")
+        with pytest.raises(OSError) as exc:
+            chaos.fire("p")
+        assert exc.value.errno == errno.ENOSPC
+        chaos.fire("p")  # hit 4: past the schedule
+
+    def test_times_fires_on_first_n_hits(self):
+        chaos.configure(
+            {"seed": 1, "faults": [{"point": "p", "kind": "rpc-error", "times": 2}]}
+        )
+        for _ in range(2):
+            with pytest.raises(chaos.ChaosRpcError):
+                chaos.fire("p")
+        chaos.fire("p")  # hit 3 passes
+
+    def test_probability_is_seed_deterministic(self):
+        def draws(seed: int) -> list[bool]:
+            chaos.configure(
+                {"seed": seed, "faults": [{"point": "p", "kind": "io-error", "p": 0.5}]}
+            )
+            out = []
+            for _ in range(32):
+                try:
+                    chaos.fire("p")
+                    out.append(False)
+                except OSError:
+                    out.append(True)
+            return out
+
+        a, b = draws(7), draws(7)
+        assert a == b, "same seed must replay the same schedule"
+        assert any(a) and not all(a), "p=0.5 over 32 hits should mix"
+        assert draws(8) != a, "a different seed should re-roll"
+
+    def test_custom_errno(self):
+        chaos.configure(
+            {
+                "seed": 1,
+                "faults": [{"point": "p", "kind": "io-error", "errno": "EIO"}],
+            }
+        )
+        with pytest.raises(OSError) as exc:
+            chaos.fire("p")
+        assert exc.value.errno == errno.EIO
+
+    def test_delay_sleeps(self):
+        chaos.configure(
+            {"seed": 1, "faults": [{"point": "p", "kind": "delay", "delay_s": 0.05}]}
+        )
+        t0 = time.perf_counter()
+        chaos.fire("p")
+        assert time.perf_counter() - t0 >= 0.04
+
+    def test_counting_mode_tracks_hits_without_faults(self):
+        chaos.configure({"seed": 0, "faults": []})
+        assert chaos.ACTIVE
+        chaos.fire("a")
+        chaos.fire("a")
+        chaos.fire("b")
+        assert chaos.hits() == {"a": 2, "b": 1}
+
+
+class TestTornWrites:
+    def test_corrupt_truncates_at_byte_k(self):
+        chaos.configure(
+            {
+                "seed": 1,
+                "faults": [
+                    {
+                        "point": "w",
+                        "kind": "torn",
+                        "at": 4,
+                        "after": 1,
+                        "then_crash": False,
+                    }
+                ],
+            }
+        )
+        assert chaos.corrupt("w", b"0123456789") == b"0123"
+        # Only the scheduled hit tears; the next write is whole.
+        assert chaos.corrupt("w", b"0123456789") == b"0123456789"
+
+    def test_wrap_file_drops_past_k(self):
+        chaos.configure(
+            {
+                "seed": 1,
+                "faults": [
+                    {"point": "w", "kind": "torn", "at": 6, "then_crash": False}
+                ],
+            }
+        )
+        buf = io.BytesIO()
+        f = chaos.wrap_file("w", buf)
+        f.write(b"0123")
+        f.write(b"456789")  # claims success, silently drops past byte 6
+        assert buf.getvalue() == b"012345"
+
+    def test_wrap_file_without_schedule_passes_through(self):
+        chaos.configure({"seed": 1, "faults": []})
+        buf = io.BytesIO()
+        assert chaos.wrap_file("w", buf) is buf
+
+
+class TestCrash:
+    def _run(self, spec: dict, body: str) -> int:
+        code = (
+            "from protocol_tpu import chaos\n"
+            f"chaos.configure({spec!r})\n" + body
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=120,
+            cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+        )
+        return proc.returncode
+
+    def test_crash_exits_with_chaos_code(self):
+        rc = self._run(
+            {"seed": 1, "faults": [{"point": "p", "kind": "crash", "after": 2}]},
+            "chaos.fire('p')\nchaos.fire('p')\nprint('unreachable')\n",
+        )
+        assert rc == chaos.CRASH_EXIT_CODE
+
+    def test_torn_then_crash_arms_next_fire(self):
+        rc = self._run(
+            {"seed": 1, "faults": [{"point": "w", "kind": "torn", "at": 2}]},
+            "out = chaos.corrupt('w', b'abcdef')\n"
+            "assert out == b'ab', out\n"
+            "chaos.fire('x')\nprint('unreachable')\n",
+        )
+        assert rc == chaos.CRASH_EXIT_CODE
+
+    def test_env_var_configures(self):
+        spec = json.dumps(
+            {"seed": 1, "faults": [{"point": "p", "kind": "crash"}]}
+        )
+        import os
+
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from protocol_tpu import chaos\nchaos.fire('p')\n",
+            ],
+            env={**os.environ, "PROTOCOL_TPU_CHAOS": spec},
+            capture_output=True,
+            timeout=120,
+            cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+        )
+        assert proc.returncode == chaos.CRASH_EXIT_CODE

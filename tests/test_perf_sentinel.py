@@ -447,3 +447,45 @@ class TestObsFreshnessSeries:
         report = json.loads(out.read_text())
         assert any("OBS_r01.json" in f for f in report["history_files"])
         assert any("freshness_p99_ms" in k for k in report["series"])
+
+
+class TestChaosRecoverySeries:
+    def test_chaos_rounds_feed_the_gate(self, tmp_path):
+        """ISSUE 14: CHAOS_r*.json is in the default globs, its
+        ``entries`` list is walked, and recovery_seconds /
+        wal_overhead_pct gate upward (a slower kill -9 recovery or a
+        heavier WAL both regress the durability plane)."""
+        for i, (recovery, overhead) in enumerate(
+            [(0.8, 1.5), (4.0, 9.0)], start=1
+        ):
+            (tmp_path / f"CHAOS_r{i:02d}.json").write_text(
+                json.dumps(
+                    {
+                        "n": i,
+                        "tool": "crash_matrix",
+                        "entries": [
+                            {
+                                "metric": "crash-matrix recovery (2000 peers)",
+                                "recovery_seconds": recovery,
+                                "wal_overhead_pct": overhead,
+                            }
+                        ],
+                    }
+                )
+            )
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1  # r02 regressed both series vs r01
+        report = json.loads(out.read_text())
+        assert {
+            "crash-matrix recovery (2000 peers) :: recovery_seconds",
+            "crash-matrix recovery (2000 peers) :: wal_overhead_pct",
+        } <= set(report["regressions"])
+
+    def test_committed_chaos_round_feeds_the_gate(self, tmp_path):
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(REPO), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert any("CHAOS_r01.json" in f for f in report["history_files"])
+        assert any("recovery_seconds" in k for k in report["series"])

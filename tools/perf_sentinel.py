@@ -4,8 +4,9 @@ The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
 ``LADDER_r*.json``, since ISSUE 7 the ingest-storm rounds
 ``INGEST_r*.json``, since ISSUE 9 the multichip comm rounds
 ``MULTICHIP_r*.json``, since ISSUE 10 the proving-plane rounds
-``PROVER_r*.json``, and since ISSUE 11 the fleet-observability rounds
-``OBS_r*.json``) but nothing ever *read* the series — a PR could
+``PROVER_r*.json``, since ISSUE 11 the fleet-observability rounds
+``OBS_r*.json``, and since ISSUE 14 the crash-matrix rounds
+``CHAOS_r*.json``) but nothing ever *read* the series — a PR could
 halve headline throughput and no gate would notice.  This tool closes
 the loop: it parses the recorded rounds into per-metric series
 (headline convergence seconds, cold/steady-state epoch seconds, plan
@@ -67,6 +68,12 @@ _FIELDS = {
     # collective wire volume of the sharded composites — a partitioner
     # surprise that inflates traffic regresses this series upward.
     "comm_bytes_per_iter": True,
+    # Crash-matrix rounds (CHAOS_r*.json): median kill -9 → serving
+    # recovery wall-clock, and the WAL's fsync cost as a percentage of
+    # the steady epoch — a slower recovery or a heavier log both
+    # regress the durability plane.
+    "recovery_seconds": True,
+    "wal_overhead_pct": True,
 }
 
 
@@ -273,6 +280,7 @@ def main(argv: list[str] | None = None) -> int:
         "MULTICHIP_r*.json",
         "PROVER_r*.json",
         "OBS_r*.json",
+        "CHAOS_r*.json",
     ]
     paths = [
         Path(p) for pat in patterns for p in globlib.glob(str(root / pat))
