@@ -37,6 +37,25 @@ def _timed(fn):
     return out, time.perf_counter() - t0
 
 
+def _peak_memory_bytes(compiled) -> int | None:
+    """Per-device peak HBM of one AOT executable from its buffer
+    assignment (graftlint pass-12 view): resident arguments + temp
+    arena + unaliased outputs.  None where the runtime exposes no
+    memory analysis."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - absent on some runtimes
+        return None
+    if ma is None:
+        return None
+    return int(
+        ma.argument_size_in_bytes
+        + ma.output_size_in_bytes
+        - ma.alias_size_in_bytes
+        + ma.temp_size_in_bytes
+    )
+
+
 def headline_entry(
     iters: int = 40,
     backend: str = "tpu-windowed",
@@ -77,6 +96,17 @@ def headline_entry(
         )
         alpha = jax.device_put(np.float32(0.1))
         jax.block_until_ready(device_args)
+        # Pass-12 memory scrape (PERF.md §19): per-device peak HBM of
+        # the exact module this bench executes, from the AOT buffer
+        # assignment — compiled once, outside the timed region, like
+        # the comm scrape.
+        extra["peak_memory_bytes"] = _peak_memory_bytes(
+            converge_csr.lower(
+                device_args[0], device_args[1], device_args[2],
+                jax.device_put(jnp.asarray(p)), device_args[3],
+                device_args[4], alpha=alpha, tol=0.0, max_iter=iters,
+            ).compile()
+        )
 
         def run():
             # t0 is donated by converge_csr: stage a fresh buffer per
@@ -121,6 +151,21 @@ def headline_entry(
             "bridge_segments": plan.n_segments,
             "bridge_compression": round(plan.compression, 2),
         }
+        # Pass-12 memory scrape: AOT buffer-assignment peak of the
+        # module this bench executes, outside the timed region.
+        extra["peak_memory_bytes"] = _peak_memory_bytes(
+            converge_windowed.lower(
+                *device_args[:7],
+                jax.device_put(jnp.asarray(p)),
+                *device_args[7:],
+                n_rows=plan.n_rows,
+                table_entries=plan.table_entries,
+                alpha=alpha,
+                tol=0.0,
+                max_iter=iters,
+                interpret=interpret,
+            ).compile()
+        )
 
         def run():
             # t0 is donated by converge_windowed: fresh buffer per call.
@@ -173,15 +218,17 @@ def headline_entry(
             mesh, swp.n, swp.rows_per_shard, swp.table_entries, swp.interpret
         )
         alpha_repl = jax.device_put(np.float32(0.1), NamedSharding(mesh, P()))
-        mod = parse_module(
-            runner.lower(
-                swp.wid, swp.local, swp.weight, swp.seg_end, swp.seg_first,
-                swp.seg_perm, swp.dst_ptr, swp.t0(), swp.p, swp.dangling,
-                alpha_repl, max_iter=iters, tol=0.0,
-            ).compile().as_text()
-        )
+        compiled = runner.lower(
+            swp.wid, swp.local, swp.weight, swp.seg_end, swp.seg_first,
+            swp.seg_perm, swp.dst_ptr, swp.t0(), swp.p, swp.dangling,
+            alpha_repl, max_iter=iters, tol=0.0,
+        ).compile()
+        mod = parse_module(compiled.as_text())
         extra["comm_bytes_per_iter"] = mod.total_bytes(per_iteration_only=True)
         extra["comm_collectives"] = mod.kind_counts()
+        # Pass-12 memory scrape: per-SHARD peak HBM (memory_analysis is
+        # the per-device view) of the same executable.
+        extra["peak_memory_bytes"] = _peak_memory_bytes(compiled)
 
         def run():
             t, it, resid = converge_sharded(swp, alpha=0.1, tol=0.0, max_iter=iters)

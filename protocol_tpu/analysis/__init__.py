@@ -21,6 +21,14 @@ This subsystem checks them by machine:
   budgets (collective kinds/counts, O(boundary + N) byte allowances
   evaluated at two scales, host round-trips, donation aliasing) against
   what the partitioner actually emitted.
+- **Pass 12** (``memory``): the static peak-HBM analyzer — reads the
+  buffer assignment of the same executables pass 8 compiles and checks
+  the declarative :data:`~protocol_tpu.analysis.budget.MEM_INVARIANTS`
+  budgets (per-shard resident bytes scaling as E/n_shards, an
+  N/n_segments-linear transient allowance in which an O(E) live
+  temporary is structurally inexpressible, donation-reduces-peak,
+  host-staging byte caps), plus the edge-materialization and
+  cache-growth AST rules over the long-lived node trees.
 
 Run as ``python -m protocol_tpu.analysis``: emits ``ANALYSIS.json``
 plus ``file:line`` findings; any error-severity finding exits non-zero
@@ -36,13 +44,16 @@ only when invoked.
 from .budget import (
     COMM_INVARIANTS,
     KERNEL_INVARIANTS,
+    MEM_INVARIANTS,
     NON_JAX_BACKENDS,
     CollectiveBudget,
     CommBudget,
     GatherBudget,
     KernelBudget,
+    MemBudget,
     declare,
     declare_comm,
+    declare_mem,
 )
 from .report import Finding, Report
 
@@ -54,8 +65,11 @@ __all__ = [
     "GatherBudget",
     "KERNEL_INVARIANTS",
     "KernelBudget",
+    "MEM_INVARIANTS",
+    "MemBudget",
     "NON_JAX_BACKENDS",
     "Report",
     "declare",
     "declare_comm",
+    "declare_mem",
 ]

@@ -41,7 +41,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--pass",
         dest="passes",
-        choices=("all", "jaxpr", "ast", "concurrency", "comm"),
+        choices=("all", "jaxpr", "ast", "concurrency", "comm", "memory"),
         default="all",
         help="which pass(es) to run (default: %(default)s)",
     )
@@ -104,6 +104,12 @@ def main(argv: list[str] | None = None) -> int:
             findings, section = run_comm_pass()
             report.extend(findings)
             report.comm = section
+        if args.passes in ("all", "memory"):
+            from .memory import run_memory_pass
+
+            findings, section = run_memory_pass()
+            report.extend(findings)
+            report.memory = section
 
     report.write_json(args.output)
     print(report.render())

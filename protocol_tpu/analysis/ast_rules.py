@@ -110,6 +110,30 @@ Pass 11 — the durability rules (ISSUE 14):
   live at host boundaries, the same doctrine as spans (pass 3) and
   journal writes (pass 5).
 
+Pass 12 — the memory-wall rules (ISSUE 15; evaluated by the memory
+pass, ``python -m protocol_tpu.analysis --pass memory``, against the
+long-lived node trees, with findings routed through the enumerated
+``analysis/memory/waivers.py`` table):
+
+- ``host-materialization-of-edges`` (error): ``np.asarray`` /
+  ``np.array`` / ``jax.device_get`` on an edge-scale array (or an
+  edge-scale ``.tolist()``) on the epoch loop's critical path
+  (``node/epoch.py`` / ``node/pipeline.py``).  An edge table is
+  hundreds of MB at the 50M-edge shape; materializing one on the host
+  per tick doubles the footprint and serializes a device->host copy
+  into the epoch cadence.  Edge-scale host work is plan build
+  (``Manager.prepare_epoch``), never the loop.
+- ``unbounded-cache-growth`` (error): a cache-named dict/list
+  attribute (``*cache*``) of a long-lived class in ``node/`` or
+  ``ingest/`` that grows (subscript store / ``append`` / ``add`` /
+  ``update`` / ``setdefault``) with no eviction anywhere in the class
+  — no ``pop``/``popitem``/``clear``, no ``del``, no generation
+  rotation (reassignment outside ``__init__``).  The ingest dedup
+  cache's two-generation rotation and the pipeline's outcome ring set
+  the precedent for what "bounded" looks like; an epoch-keyed cache
+  without eviction leaks with uptime (a cached f32[N] score vector
+  per epoch is 4 MB/epoch at 1M peers — 34 GB/day at a 10 s cadence).
+
 Pass 9 — the proving-plane boundary rule (ISSUE 10):
 
 - ``blocking-prove-in-epoch-loop`` (error): a synchronous prover
@@ -400,6 +424,81 @@ def _is_depth_gauge_write(node: ast.Call, name: str | None) -> bool:
     return False
 
 
+#: Pass-12 host-materialization entry points: calls that force a full
+#: device->host copy of their operand.
+_MATERIALIZE_CALLS = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array", "jax.device_get"}
+)
+
+#: Identifier tokens that mark an array as edge-scale (the O(E) data:
+#: edge endpoints/weights, window-plan rows/slots, segment tables).
+_EDGE_NAME_TOKENS = frozenset(
+    {"src", "dst", "edge", "edges", "weight", "weights", "wid", "seg",
+     "segs", "local"}
+)
+
+
+def _is_edge_name(name: str | None) -> bool:
+    """True when a dotted name's leaf looks like an edge-scale array
+    (``plan.seg_dst``, ``graph.src``, ``self._edge_weights``)."""
+    if name is None:
+        return False
+    leaf = name.rsplit(".", 1)[-1].lower()
+    return any(tok in _EDGE_NAME_TOKENS for tok in leaf.split("_") if tok)
+
+
+def _materialized_edge_name(node: ast.Call, name: str | None) -> str | None:
+    """The edge-scale dotted name a pass-12 materialization call moves
+    to the host, or None: ``np.asarray(<edge>)`` / ``jax.device_get(
+    <edge>)`` by first argument, ``<edge>.tolist()`` by receiver."""
+    if name is None:
+        return None
+    if name in _MATERIALIZE_CALLS and node.args:
+        arg = _dotted(node.args[0])
+        if _is_edge_name(arg):
+            return f"{name}({arg})"
+        return None
+    if name.rsplit(".", 1)[-1] == "tolist" and "." in name:
+        receiver = name.rsplit(".", 1)[0]
+        if _is_edge_name(receiver):
+            return f"{receiver}.tolist()"
+    return None
+
+
+#: Pass-12 cache-growth bookkeeping: cache-named attributes, the calls
+#: that grow them, and the calls that count as eviction.
+_CACHE_GROW_LEAVES = frozenset({"append", "add", "update", "setdefault"})
+_CACHE_EVICT_LEAVES = frozenset({"pop", "popitem", "clear"})
+
+
+def _is_cache_attr_name(attr: str) -> bool:
+    return "cache" in attr.lower()
+
+
+def _empty_container_ctor(value: ast.expr) -> bool:
+    """``{}`` / ``[]`` / ``dict(...)`` / ``list()`` / ``defaultdict(...)``
+    — the shapes a growable cache starts from."""
+    if isinstance(value, (ast.Dict, ast.List)):
+        return True
+    if isinstance(value, ast.Call):
+        ctor = _dotted(value.func)
+        return ctor is not None and ctor.rsplit(".", 1)[-1] in (
+            "dict", "list", "defaultdict", "OrderedDict",
+        )
+    return False
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    """``self.<attr>`` -> attr name, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
 #: Chaos hook entry points (pass 11): host-boundary-only, like spans.
 _CHAOS_LEAVES = frozenset({"fire", "corrupt", "wrap_file"})
 
@@ -466,12 +565,21 @@ class _Visitor(ast.NodeVisitor):
         kernel_tree: bool = False,
         epoch_loop: bool = False,
         node_tree: bool = False,
+        mem_rules: bool = False,
     ) -> None:
         self.rel_path = rel_path
         self.hot = hot
         self.kernel_tree = kernel_tree
         self.epoch_loop = epoch_loop
         self.node_tree = node_tree
+        #: Pass-12 rules armed (the memory pass scans the long-lived
+        #: trees with these on; the plain AST pass leaves them off so
+        #: findings are never double-reported across passes).
+        self.mem_rules = mem_rules
+        #: Pass-12 per-class state: cache-named container attrs
+        #: initialized in __init__ vs growth/eviction evidence,
+        #: resolved when the ClassDef closes.
+        self._class_frames: list[dict] = []
         #: Pass-11 per-function state: write sites collected until the
         #: function closes, when the _atomic_write/fsync exemptions
         #: resolve (the discipline lives in the same function as the
@@ -540,6 +648,76 @@ class _Visitor(ast.NodeVisitor):
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
 
+    # -- pass 12: unbounded cache growth (class-scoped) ------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if not self.mem_rules:
+            self.generic_visit(node)
+            return
+        self._class_frames.append(
+            {"inits": {}, "grows": set(), "evicts": set()}
+        )
+        self.generic_visit(node)
+        frame = self._class_frames.pop()
+        for attr, site in frame["inits"].items():
+            if attr in frame["grows"] and attr not in frame["evicts"]:
+                self._emit(
+                    "unbounded-cache-growth",
+                    f"cache attribute {node.name}.{attr} of a long-lived "
+                    f"class grows with no eviction, size bound, or "
+                    f"epoch rotation anywhere in the class — an "
+                    f"epoch-keyed cache without eviction leaks with "
+                    f"uptime (the ingest dedup cache's generation "
+                    f"rotation and the pipeline's outcome ring are the "
+                    f"sanctioned shapes)",
+                    site,
+                )
+
+    def _in_init(self) -> bool:
+        return bool(self._fn_frames) and self._fn_frames[-1]["name"] == "__init__"
+
+    def _note_cache_assign(self, target: ast.expr, value: ast.expr | None,
+                           node: ast.stmt) -> None:
+        """Pass-12 bookkeeping for one assignment statement."""
+        if not self._class_frames:
+            return
+        frame = self._class_frames[-1]
+        if isinstance(target, ast.Subscript):
+            attr = _self_attr(target.value)
+            if attr is not None and _is_cache_attr_name(attr):
+                frame["grows"].add(attr)
+            return
+        attr = _self_attr(target)
+        if attr is None or not _is_cache_attr_name(attr):
+            return
+        if self._in_init():
+            if value is not None and _empty_container_ctor(value):
+                frame["inits"][attr] = node
+        else:
+            # Reassignment outside __init__ is generation rotation —
+            # the dedup-cache shape — and counts as eviction.
+            frame["evicts"].add(attr)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self.mem_rules:
+            for target in node.targets:
+                self._note_cache_assign(target, node.value, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if self.mem_rules:
+            self._note_cache_assign(node.target, node.value, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        if self.mem_rules and self._class_frames:
+            for target in node.targets:
+                if isinstance(target, ast.Subscript):
+                    attr = _self_attr(target.value)
+                    if attr is not None and _is_cache_attr_name(attr):
+                        self._class_frames[-1]["evicts"].add(attr)
+        self.generic_visit(node)
+
     # -- rules ----------------------------------------------------------
 
     def visit_Call(self, node: ast.Call) -> None:
@@ -550,6 +728,33 @@ class _Visitor(ast.NodeVisitor):
             self.bounded_queue_sites.append(node)
         elif _is_depth_gauge_write(node, name):
             self.has_depth_gauge = True
+        if self.mem_rules and self._class_frames and isinstance(
+            node.func, ast.Attribute
+        ):
+            # Pass-12 bookkeeping: self.<cache>.append/add/update grows,
+            # self.<cache>.pop/popitem/clear evicts.
+            attr = _self_attr(node.func.value)
+            if attr is not None and _is_cache_attr_name(attr):
+                if node.func.attr in _CACHE_GROW_LEAVES:
+                    self._class_frames[-1]["grows"].add(attr)
+                elif node.func.attr in _CACHE_EVICT_LEAVES:
+                    self._class_frames[-1]["evicts"].add(attr)
+        if self.mem_rules and self.epoch_loop:
+            # Pass 12: no edge-scale host materialization on the epoch
+            # loop's critical path — an edge table is hundreds of MB at
+            # the 50M-edge shape, and the copy serializes into the tick.
+            offender = _materialized_edge_name(node, name)
+            if offender is not None:
+                self._emit(
+                    "host-materialization-of-edges",
+                    f"{offender} on an epoch-loop code path materializes "
+                    "an edge-scale array on the host: O(E) bytes copied "
+                    "device->host per tick, doubling the footprint the "
+                    "memory budgets pin — edge-scale host work belongs "
+                    "in plan build (Manager.prepare_epoch), never the "
+                    "loop",
+                    node,
+                )
         if self.node_tree:
             # Pass 11 bookkeeping: write sites vs the enclosing
             # function's fsync discipline (resolved at function close;
@@ -753,9 +958,14 @@ def _is_hot(rel_path: str) -> bool:
     return _in_tree(rel_path, HOT_TREES)
 
 
-def scan_source(source: str, rel_path: str) -> list[Finding]:
+def scan_source(
+    source: str, rel_path: str, mem_rules: bool = False
+) -> list[Finding]:
     """Run the AST ruleset over in-memory source (seeded violation
-    fixtures use this; ``scan_file`` is the on-disk wrapper)."""
+    fixtures use this; ``scan_file`` is the on-disk wrapper).
+    ``mem_rules`` arms the pass-12 rules — the memory pass's AST leg;
+    the plain AST pass leaves them off so the two passes never
+    double-report."""
     try:
         tree = ast.parse(source, filename=rel_path)
     except SyntaxError as exc:
@@ -775,6 +985,7 @@ def scan_source(source: str, rel_path: str) -> list[Finding]:
         kernel_tree=_in_tree(rel_path, KERNEL_TREES),
         epoch_loop=rel_path in EPOCH_LOOP_FILES,
         node_tree=_in_tree(rel_path, ("node",)),
+        mem_rules=mem_rules,
     )
     visitor.visit(tree)
     if visitor.bounded_queue_sites and not visitor.has_depth_gauge:
@@ -812,11 +1023,46 @@ def run_ast_pass(root: str | Path | None = None) -> tuple[list[Finding], int]:
     return findings, len(files)
 
 
+#: Rules the memory pass's AST leg reports (everything else the armed
+#: visitor would emit is the plain AST pass's job — filtering here
+#: keeps ``--pass all`` from reporting the same finding twice).
+MEM_AST_RULES = frozenset(
+    {"host-materialization-of-edges", "unbounded-cache-growth"}
+)
+
+#: Long-lived trees the pass-12 AST rules police: the node (epoch loop,
+#: manager, checkpoint) and admission-plane classes live for the
+#: process, so an unevicted cache there leaks with uptime.
+MEM_AST_TREES = ("node", "ingest")
+
+
+def run_mem_ast_pass(root: str | Path | None = None) -> tuple[list[Finding], int]:
+    """Pass 12's AST leg: scan the long-lived trees with the memory
+    rules armed; returns ``(mem-rule findings, files scanned)``."""
+    if root is None:
+        root = Path(__file__).resolve().parent.parent.parent
+    root = Path(root)
+    findings: list[Finding] = []
+    files = [
+        path
+        for tree in MEM_AST_TREES
+        for path in sorted((root / "protocol_tpu" / tree).rglob("*.py"))
+    ]
+    for path in files:
+        rel = str(path.relative_to(root))
+        found = scan_source(path.read_text(), rel, mem_rules=True)
+        findings.extend(f for f in found if f.rule in MEM_AST_RULES)
+    return findings, len(files)
+
+
 __all__ = [
     "EPOCH_LOOP_FILES",
     "HOT_TREES",
     "KERNEL_TREES",
+    "MEM_AST_RULES",
+    "MEM_AST_TREES",
     "run_ast_pass",
+    "run_mem_ast_pass",
     "scan_file",
     "scan_source",
 ]

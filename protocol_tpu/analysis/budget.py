@@ -201,6 +201,111 @@ def declare_comm(budget: CommBudget) -> CommBudget:
     return budget
 
 
+# ---------------------------------------------------------------------------
+# Memory budgets — the ``MEM_INVARIANTS`` table (graftlint pass 12)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MemBudget:
+    """Per-backend peak-HBM contract checked by pass 12 against the
+    compiled module's buffer assignment (``compiled.memory_analysis()``,
+    with a conservative live-range walk over the optimized HLO as
+    fallback).  All numbers are the **per-device** view — under the
+    8-way analysis mesh that IS the per-shard footprint, so "per-shard
+    peak scales as E/n_shards" is the formula itself, not a separate
+    rule.
+
+    The allowance decomposes into two declarative halves:
+
+    - **resident** — the argument arrays the kernel holds for the whole
+      call (edge tables, window-plan rows, segment tables, score
+      vectors).  The edge term is divided by ``n_shards``: an
+      accidentally replicated edge operand busts the budget by
+      construction (``shard-replicated-edges``).
+    - **transient** — XLA's temp arena plus unaliased outputs: the
+      iteration's live working set.  It is linear in N, n_segments,
+      and plan vreg-rows only — there is **no edge coefficient**, so a
+      second O(E)-sized live buffer beyond the declared resident
+      arrays is structurally inexpressible (``o-e-live-temporary``).
+      ``transient_rows`` exists for the windowed kernels: the Pallas
+      interpret-mode compile re-expresses the Mosaic kernel as XLA
+      ops, and its scratch is a small multiple of the 8 KB row tables
+      (on the real chip this is VMEM scratch, not HBM) — rows are a
+      plan-layout dimension (1024 edge slots each), never a raw edge
+      count.
+
+    Coefficients are pinned tight: the analyzer compiles the sharded
+    composites at two scales where E grows 4x vs N's 2x, and the
+    acceptance test asserts the committed slack is below a 4 B/edge
+    live temporary at *either* scale — the COMM_INVARIANTS pinning
+    trick (PERF.md §15), applied to liveness instead of wire bytes.
+    """
+
+    backend: str
+    #: Resident (argument) allowance coefficients.
+    resident_edge_bytes: float = 0.0  # x E / n_shards
+    resident_n: float = 0.0  # x N
+    resident_segments: float = 0.0  # x n_segments (per-shard table)
+    resident_rows: float = 0.0  # x plan vreg-rows (per shard)
+    resident_const: float = 0.0
+    #: Transient (temp arena + unaliased output) allowance — NO edge
+    #: coefficient can be declared here, by construction.
+    transient_n: float = 0.0
+    transient_segments: float = 0.0
+    transient_rows: float = 0.0
+    transient_const: float = 0.0
+    #: Arguments whose donation must materialize as buffer aliasing:
+    #: a dropped alias shows up as a doubled f32[N] carry
+    #: (``donation-peak-doubled``).  Each entry is an f32[N] seed.
+    donated_args: tuple[str, ...] = ()
+    #: Per-op host-transfer byte cap (``staging_n * N + staging_const``):
+    #: a transfer custom-call carrying more than this — an O(E) staging
+    #: copy outside plan build — is a ``host-staging-over-cap`` finding.
+    staging_n: float = 0.0
+    staging_const: float = 0.0
+    #: Free-form rationale recorded in ANALYSIS.json.
+    notes: str = ""
+
+    def max_resident(
+        self, n: int, edges: int, n_segments: int, rows: int, n_shards: int
+    ) -> float:
+        return (
+            self.resident_edge_bytes * edges / max(n_shards, 1)
+            + self.resident_n * n
+            + self.resident_segments * n_segments
+            + self.resident_rows * rows
+            + self.resident_const
+        )
+
+    def max_transient(self, n: int, n_segments: int, rows: int) -> float:
+        return (
+            self.transient_n * n
+            + self.transient_segments * n_segments
+            + self.transient_rows * rows
+            + self.transient_const
+        )
+
+    def staging_cap(self, n: int) -> float:
+        return self.staging_n * n + self.staging_const
+
+
+#: backend name -> declared memory budget.  Populated by kernel modules
+#: at import (next to their KERNEL_INVARIANTS / COMM_INVARIANTS
+#: declarations); read by ``protocol_tpu.analysis.memory`` and
+#: cross-checked against the ``trust/backend.py`` registry — a
+#: registered jax backend without an entry is an error, the same policy
+#: as kernel and comm budgets.
+MEM_INVARIANTS: dict[str, MemBudget] = {}
+
+
+def declare_mem(budget: MemBudget) -> MemBudget:
+    """Register a memory budget (idempotent per backend name; kernel
+    modules call this at import time, next to ``declare``)."""
+    MEM_INVARIANTS[budget.backend] = budget
+    return budget
+
+
 __all__ = [
     "COLLECTIVE_KINDS",
     "COMM_INVARIANTS",
@@ -209,7 +314,10 @@ __all__ = [
     "GatherBudget",
     "KernelBudget",
     "KERNEL_INVARIANTS",
+    "MEM_INVARIANTS",
+    "MemBudget",
     "NON_JAX_BACKENDS",
     "declare",
     "declare_comm",
+    "declare_mem",
 ]

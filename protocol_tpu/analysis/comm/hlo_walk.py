@@ -146,10 +146,16 @@ class HostCall:
     target: str  # custom_call_target, or "" for infeed/outfeed/send/recv
     file: str | None
     line: int | None
+    #: Bytes the transfer carries — max of what goes out (operands) and
+    #: what comes back (result), from the typed shapes.  Pass 12 caps
+    #: this per op (``host-staging-over-cap``): an O(E) staging copy
+    #: outside plan build is a finding even where a round-trip per se
+    #: is waived.
+    bytes: int = 0
 
     def to_dict(self) -> dict:
         return {"op": self.op, "target": self.target, "file": self.file,
-                "line": self.line}
+                "line": self.line, "bytes": self.bytes}
 
 
 @dataclass
@@ -205,14 +211,17 @@ def parse_module(text: str) -> ModuleComm:
         lineno = int(meta.group("line")) if meta else None
         if op.endswith("-done"):
             continue  # the matching -start carries the transfer
+        volume = max(shape_bytes(m.group("result")), shape_bytes(m.group("operands")))
         if op == "custom-call":
             target = _CUSTOM_TARGET.search(attrs)
             name = target.group("target") if target else ""
             if any(mark in name.lower() for mark in _HOST_TARGET_MARKS):
-                mod.host_calls.append(HostCall("custom-call", name, file, lineno))
+                mod.host_calls.append(
+                    HostCall("custom-call", name, file, lineno, bytes=volume)
+                )
             continue
         if op in ("infeed", "outfeed", "send", "recv"):
-            mod.host_calls.append(HostCall(op, "", file, lineno))
+            mod.host_calls.append(HostCall(op, "", file, lineno, bytes=volume))
             continue
         groups = _REPLICA_GROUPS.search(attrs)
         op_name = _OP_NAME.search(attrs)

@@ -38,8 +38,9 @@ class CommCase:
     """One backend at one scale: the compiled module plus its context."""
 
     backend: str
-    #: Budget dimensions: n, edges, n_shards, and n_segments where the
-    #: backend has a segment table.
+    #: Budget dimensions: n, edges, n_shards, n_segments where the
+    #: backend has a segment table, and n_rows where it has a windowed
+    #: plan (per-shard vreg-rows — the pass-12 resident dimension).
     dims: dict[str, int]
     #: ``compiled.as_text()`` of the converge entry point.
     module_text: str
@@ -47,8 +48,34 @@ class CommCase:
     arg_names: tuple[str, ...]
     #: psum/psum2 count in the traced jaxpr of the same entry point.
     jaxpr_psums: int = 0
+    #: Buffer-assignment view of the same executable (pass 12):
+    #: ``compiled.memory_analysis()`` per-device byte totals, or None
+    #: when the runtime exposes no memory analysis — the memory checker
+    #: then falls back to the conservative live-range walk over
+    #: ``module_text``.
+    mem: dict[str, int] | None = None
     #: Free-form per-scale metadata for ANALYSIS.json.
     meta: dict[str, Any] = field(default_factory=dict)
+
+
+def _mem_stats(compiled: Any) -> dict[str, int] | None:
+    """Per-device buffer-assignment totals of one executable, or None
+    when the backend has no ``memory_analysis`` (older runtimes)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - absence degrades to the HLO walk
+        return None
+    if ma is None:
+        return None
+    try:
+        return {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+        }
+    except AttributeError:
+        return None
 
 
 def _graph(n: int, e: int):
@@ -90,14 +117,15 @@ def _lower_dense(n: int, e: int) -> CommCase:
     m /= m.sum(axis=0, keepdims=True)
     t = jnp.asarray(np.full(size, 1.0 / size, np.float32))
     m = jnp.asarray(m)
-    lowered = converge_dense.lower(m, t, 4)
+    compiled = converge_dense.lower(m, t, 4).compile()
     jaxpr = jax.make_jaxpr(lambda mm, tt: converge_dense(mm, tt, 4))(m, t)
     return CommCase(
         backend="tpu-dense",
         dims={"n": size, "edges": size * size, "n_shards": 1},
-        module_text=lowered.compile().as_text(),
+        module_text=compiled.as_text(),
         arg_names=("ops_t", "s0"),
         jaxpr_psums=_jaxpr_psums(jaxpr),
+        mem=_mem_stats(compiled),
     )
 
 
@@ -118,16 +146,17 @@ def _lower_sparse(n: int, e: int) -> CommCase:
         jnp.asarray(dangling),
     )
     kw = dict(n=g.n, alpha=jnp.asarray(0.1, jnp.float32), tol=1e-6, max_iter=4)
-    lowered = converge_sparse.lower(*args, **kw)
+    compiled = converge_sparse.lower(*args, **kw).compile()
     jaxpr = jax.make_jaxpr(
         lambda *a: converge_sparse(*a, **kw), static_argnums=()
     )(*args)
     return CommCase(
         backend="tpu-sparse",
         dims={"n": g.n, "edges": g.nnz, "n_shards": 1},
-        module_text=lowered.compile().as_text(),
+        module_text=compiled.as_text(),
         arg_names=("src", "dst", "w", "t0", "p", "dangling"),
         jaxpr_psums=_jaxpr_psums(jaxpr),
+        mem=_mem_stats(compiled),
     )
 
 
@@ -148,14 +177,15 @@ def _lower_csr(n: int, e: int) -> CommCase:
         jnp.asarray(dangling),
     )
     kw = dict(alpha=jnp.asarray(0.1, jnp.float32), tol=1e-6, max_iter=4)
-    lowered = converge_csr.lower(*args, **kw)
+    compiled = converge_csr.lower(*args, **kw).compile()
     jaxpr = jax.make_jaxpr(lambda *a: converge_csr(*a, **kw))(*args)
     return CommCase(
         backend="tpu-csr",
         dims={"n": g.n, "edges": g.nnz, "n_shards": 1},
-        module_text=lowered.compile().as_text(),
+        module_text=compiled.as_text(),
         arg_names=("src", "row_ptr", "w", "t0", "p", "dangling"),
         jaxpr_psums=_jaxpr_psums(jaxpr),
+        mem=_mem_stats(compiled),
     )
 
 
@@ -181,7 +211,7 @@ def _lower_windowed(n: int, e: int) -> CommCase:
         max_iter=4,
         interpret=True,
     )
-    lowered = converge_windowed.lower(*args, **kw)
+    compiled = converge_windowed.lower(*args, **kw).compile()
     jaxpr = jax.make_jaxpr(lambda *a: converge_windowed(*a, **kw))(*args)
     return CommCase(
         backend="tpu-windowed",
@@ -189,14 +219,16 @@ def _lower_windowed(n: int, e: int) -> CommCase:
             "n": g.n,
             "edges": g.nnz,
             "n_segments": plan.seg_capacity,
+            "n_rows": plan.n_rows,
             "n_shards": 1,
         },
-        module_text=lowered.compile().as_text(),
+        module_text=compiled.as_text(),
         arg_names=(
             "wid", "local", "weight", "seg_end", "seg_first", "seg_perm",
             "dst_ptr", "t0", "p", "dangling",
         ),
         jaxpr_psums=_jaxpr_psums(jaxpr),
+        mem=_mem_stats(compiled),
     )
 
 
@@ -217,7 +249,7 @@ def _lower_sharded_csr(n: int, e: int) -> CommCase:
         jnp.asarray(0.1, jnp.float32),
     )
     kw = dict(max_iter=4, tol=1e-6)
-    lowered = run.lower(*args, **kw)
+    compiled = run.lower(*args, **kw).compile()
     jaxpr = jax.make_jaxpr(partial(run, **kw))(*args)
     return CommCase(
         backend="tpu-sharded:tpu-csr",
@@ -226,9 +258,10 @@ def _lower_sharded_csr(n: int, e: int) -> CommCase:
             "edges": int(prob.src.shape[0]),
             "n_shards": mesh.shape[SHARD_AXIS],
         },
-        module_text=lowered.compile().as_text(),
+        module_text=compiled.as_text(),
         arg_names=("src", "w", "row_ptr", "t0", "p", "dangling", "alpha"),
         jaxpr_psums=_jaxpr_psums(jaxpr),
+        mem=_mem_stats(compiled),
     )
 
 
@@ -253,7 +286,7 @@ def _lower_sharded_windowed(n: int, e: int) -> CommCase:
         jnp.asarray(0.1, jnp.float32),
     )
     kw = dict(max_iter=4, tol=1e-6)
-    lowered = run.lower(*args, **kw)
+    compiled = run.lower(*args, **kw).compile()
     jaxpr = jax.make_jaxpr(partial(run, **kw))(*args)
     return CommCase(
         backend="tpu-sharded:tpu-windowed",
@@ -261,14 +294,16 @@ def _lower_sharded_windowed(n: int, e: int) -> CommCase:
             "n": swp.n,
             "edges": int(graph.drop_self_edges().nnz),
             "n_segments": swp.s_max,
+            "n_rows": swp.rows_per_shard,
             "n_shards": mesh.shape[SHARD_AXIS],
         },
-        module_text=lowered.compile().as_text(),
+        module_text=compiled.as_text(),
         arg_names=(
             "wid", "local", "weight", "seg_end", "seg_first", "seg_perm",
             "dst_ptr", "t0", "p", "dangling", "alpha",
         ),
         jaxpr_psums=_jaxpr_psums(jaxpr),
+        mem=_mem_stats(compiled),
     )
 
 
@@ -285,12 +320,28 @@ COMM_BUILDERS: dict[str, tuple[Callable[[int, int], CommCase], bool]] = {
 }
 
 
+#: Per-process case memo: pass 8 and pass 12 judge the SAME executables
+#: (comm walks the module text, memory the buffer assignment), so a
+#: full ``--pass all`` run compiles each backend once, not twice — the
+#: windowed Pallas-interpret compiles dominate the analyzer's wall
+#: clock (the self-budget test).  Keyed by backend; the recipes are
+#: deterministic in-process, and the synthetic graphs never change
+#: under one run.
+_CASE_CACHE: dict[str, list[CommCase]] = {}
+
+
 def build_cases(backend: str) -> list[CommCase]:
     """Compile ``backend`` at its scale set and return one case per
-    scale.  Raises KeyError for a backend without a recipe."""
+    scale (memoized per process).  Raises KeyError for a backend
+    without a recipe."""
+    cached = _CASE_CACHE.get(backend)
+    if cached is not None:
+        return cached
     recipe, two_scale = COMM_BUILDERS[backend]
     scales = COMM_SCALES if two_scale else COMM_SCALES[:1]
-    return [recipe(n, e) for n, e in scales]
+    cases = [recipe(n, e) for n, e in scales]
+    _CASE_CACHE[backend] = cases
+    return cases
 
 
 __all__ = ["COMM_BUILDERS", "COMM_SCALES", "CommCase", "N_SHARDS", "build_cases"]

@@ -519,22 +519,24 @@ def _comm_mesh():
     return default_mesh(), SHARD_AXIS
 
 
-def _comm_case(backend, fn, args, dims, arg_names=()):
+def _comm_case(backend, fn, args, dims, arg_names=(), donate=()):
     """Compile ``fn`` and wrap it as a CommCase (jaxpr psums counted
-    from the same trace the module was lowered from)."""
+    from the same trace the module was lowered from; the buffer
+    assignment rides along for the pass-12 fixtures)."""
     import jax
 
-    from .comm.lowering import CommCase
+    from .comm.lowering import CommCase, _mem_stats
     from .jaxpr_walk import PSUM_PRIMITIVES, collect_primitives
 
-    lowered = jax.jit(fn).lower(*args)
+    compiled = jax.jit(fn, donate_argnames=tuple(donate)).lower(*args).compile()
     jaxpr = jax.make_jaxpr(fn)(*args)
     return CommCase(
         backend=backend,
         dims=dims,
-        module_text=lowered.compile().as_text(),
+        module_text=compiled.as_text(),
         arg_names=tuple(arg_names),
         jaxpr_psums=len(collect_primitives(jaxpr, PSUM_PRIMITIVES)),
+        mem=_mem_stats(compiled),
     )
 
 
@@ -696,6 +698,188 @@ def _psum_lowering_mismatch():
     return budget, [case]
 
 
+#: Pass-12 seeded violations (peak-HBM rules).  The lowering fixtures
+#: compile REAL modules through the real jit path and judge their
+#: buffer assignment against a MemBudget they violate; anchored
+#: fixtures resolve through the largest-temp / host-transfer HLO
+#: metadata back to the ``# VIOLATION:`` line, the same file:line
+#: contract as the comm fixtures.  The AST fixtures ride source
+#: strings scanned with the memory rules armed (kind="mem-ast").
+
+
+def _o_e_live_temporary():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .budget import MemBudget
+
+    n, e = 512, 4096
+    src = jnp.asarray(np.arange(e, dtype=np.int32) % n)
+    w = jnp.asarray(np.ones(e, np.float32))
+    t = jnp.asarray(np.ones(n, np.float32))
+
+    def step(src, w, t):
+        # The anti-pattern the transient budget exists to forbid: a
+        # full edge-sized contribution vector held live across two
+        # reductions instead of streamed through the fused pipeline.
+        contrib = w * t[src]  # VIOLATION: o-e-live-temporary
+        return jnp.sum(contrib) + jnp.sum(contrib * contrib)
+
+    budget = MemBudget(
+        backend="fixture:o-e-live-temporary",
+        resident_edge_bytes=8.0,  # src + w are legal resident inputs
+        resident_n=8.0,
+        resident_const=4096.0,
+        transient_n=8.0,  # N-linear only: the E-sized temp must trip
+        transient_const=1024.0,
+    )
+    case = _comm_case(
+        "fixture:o-e-live-temporary", step, (src, w, t),
+        dims={"n": n, "edges": e, "n_shards": 1},
+    )
+    return budget, [case]
+
+
+def _donation_peak_doubled():
+    import jax.numpy as jnp
+
+    from .budget import MemBudget
+
+    def undonated(t0, p):  # no donate_argnames — the alias never lowers
+        return t0 * 0.9 + p * 0.1
+
+    budget = MemBudget(
+        backend="fixture:donation-peak-doubled",
+        resident_n=16.0,
+        resident_const=4096.0,
+        transient_n=64.0,  # generous: only the donation rule may fire
+        transient_const=65536.0,
+        donated_args=("t0",),
+    )
+    n = 1024
+    case = _comm_case(
+        "fixture:donation-peak-doubled", undonated,
+        (jnp.ones(n, jnp.float32), jnp.ones(n, jnp.float32)),
+        dims={"n": n, "n_shards": 1}, arg_names=("t0", "p"),
+    )
+    return budget, [case]
+
+
+def _shard_replicated_edges():
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharded import _shard_map
+    from .budget import MemBudget
+
+    mesh, axis = _comm_mesh()
+    n_shards = mesh.shape[axis]
+    n, e = 64, 8192
+    # The regression ROADMAP item 1 cannot afford: the edge-sized
+    # operand REPLICATED onto every shard instead of partitioned —
+    # per-device resident holds all E entries, not E/n_shards.
+    w = jax.device_put(np.ones(e, np.float32), NamedSharding(mesh, P()))
+
+    @partial(_shard_map, mesh=mesh, in_specs=P(), out_specs=P())
+    def step(w_full):  # VIOLATION: shard-replicated-edges
+        return lax.psum(jnp.sum(w_full), "shard") / n_shards
+
+    budget = MemBudget(
+        backend="fixture:shard-replicated-edges",
+        resident_edge_bytes=4.0,  # f32 edge weights, PER SHARD
+        resident_n=16.0,
+        resident_const=4096.0,
+        transient_n=64.0,
+        transient_const=65536.0,
+    )
+    case = _comm_case(
+        "fixture:shard-replicated-edges", step, (w,),
+        dims={"n": n, "edges": e, "n_shards": n_shards},
+    )
+    return budget, [case]
+
+
+def _host_staging_over_cap():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .budget import MemBudget
+
+    n, e = 64, 8192
+
+    def host_norm(x):
+        return np.float32(np.abs(np.asarray(x)).sum())
+
+    def step(edges):
+        out = jax.ShapeDtypeStruct((), jnp.float32)
+        # An O(E) operand shipped through a host callback: the staging
+        # cap (O(N) bytes) exists to keep edge-scale data on-device.
+        s = jax.pure_callback(host_norm, out, edges)  # VIOLATION: host-staging-over-cap
+        return edges * s
+
+    budget = MemBudget(
+        backend="fixture:host-staging-over-cap",
+        resident_edge_bytes=4.0,
+        resident_const=4096.0,
+        transient_n=64.0,
+        transient_const=65536.0,
+        staging_n=4.0,  # an f32[N] scalar reduction would be fine
+    )
+    case = _comm_case(
+        "fixture:host-staging-over-cap", step,
+        (jnp.ones(e, jnp.float32),),
+        dims={"n": n, "edges": e, "n_shards": 1},
+    )
+    return budget, [case]
+
+
+_HOST_MATERIALIZATION_SRC = '''\
+import numpy as np
+
+
+def device_stage(manager, prepared, plan):
+    # Materializing an edge-scale plan column on the host per tick:
+    # O(E) bytes copied device->host inside the epoch cadence — edge
+    # host work belongs in plan build (Manager.prepare_epoch).
+    seg_dst = np.asarray(plan.seg_dst)  # VIOLATION: host-materialization-of-edges
+    return seg_dst.shape[0]
+'''
+
+
+def _host_materialization_of_edges() -> tuple[str, str]:
+    # The fake path lands on an epoch-loop file so the file-scoped
+    # pass-12 rule applies exactly as it would to the real module.
+    return _HOST_MATERIALIZATION_SRC, "protocol_tpu/node/pipeline.py"
+
+
+_UNBOUNDED_CACHE_SRC = '''\
+class ScoreServer:
+    """A long-lived node class with an epoch-keyed cache that only
+    ever grows — the leak the ring-eviction doctrine exists to stop
+    (4 MB of f32[N] scores per epoch at 1M peers)."""
+
+    def __init__(self):
+        self._score_cache = {}  # VIOLATION: unbounded-cache-growth
+
+    def publish(self, epoch, scores):
+        self._score_cache[epoch] = scores
+
+    def serve(self, epoch):
+        return self._score_cache.get(epoch)
+'''
+
+
+def _unbounded_cache_growth() -> tuple[str, str]:
+    return _UNBOUNDED_CACHE_SRC, "protocol_tpu/node/_fixture_cache_growth.py"
+
+
 FIXTURES: dict[str, Fixture] = {
     f.name: f
     for f in (
@@ -807,6 +991,32 @@ FIXTURES: dict[str, Fixture] = {
             "psum-lowering-mismatch", "psum-lowering-mismatch",
             _psum_lowering_mismatch, "psum-lowering-mismatch", kind="comm",
         ),
+        Fixture(
+            "o-e-live-temporary", "o-e-live-temporary",
+            _o_e_live_temporary, "o-e-live-temporary", kind="mem",
+        ),
+        Fixture(
+            "donation-peak-doubled", "donation-peak-doubled",
+            _donation_peak_doubled, None, kind="mem",
+        ),
+        Fixture(
+            "shard-replicated-edges", "shard-replicated-edges",
+            _shard_replicated_edges, None, kind="mem",
+        ),
+        Fixture(
+            "host-staging-over-cap", "host-staging-over-cap",
+            _host_staging_over_cap, "host-staging-over-cap", kind="mem",
+        ),
+        Fixture(
+            "host-materialization-of-edges", "host-materialization-of-edges",
+            _host_materialization_of_edges, "host-materialization-of-edges",
+            kind="mem-ast",
+        ),
+        Fixture(
+            "unbounded-cache-growth", "unbounded-cache-growth",
+            _unbounded_cache_growth, "unbounded-cache-growth",
+            kind="mem-ast",
+        ),
     )
 }
 
@@ -830,6 +1040,16 @@ def run_fixture(name: str) -> list[Finding]:
 
         budget, cases = fixture.build()
         return [f for c in cases for f in check_comm_case(budget, c)[0]]
+    if fixture.kind == "mem":
+        from .memory.checker import check_mem_case
+
+        budget, cases = fixture.build()
+        return [f for c in cases for f in check_mem_case(budget, c)[0]]
+    if fixture.kind == "mem-ast":
+        from .ast_rules import scan_source
+
+        source, rel_path = fixture.build()
+        return scan_source(source, rel_path, mem_rules=True)
     budget, case = fixture.build()
     return check_case(budget, case)
 

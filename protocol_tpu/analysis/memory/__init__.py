@@ -1,0 +1,11 @@
+"""graftlint pass 12 — the static peak-HBM analyzer (memory wall).
+
+See ``checker.py`` for the rule set, ``liveness.py`` for the
+buffer-assignment / live-range machinery, and ``waivers.py`` for the
+enumerated, stale-tested suppression table.
+"""
+
+from .checker import check_mem_case, run_memory_pass
+from .waivers import MEM_WAIVERS
+
+__all__ = ["MEM_WAIVERS", "check_mem_case", "run_memory_pass"]

@@ -1,0 +1,323 @@
+"""Pass 12 — the static peak-HBM analyzer.
+
+Pass 8 proved the partitioner keeps the *wire* promise; this pass
+proves the backend keeps the *footprint* promise.  ROADMAP item 1
+(10M peers / 500M edges across >= 2 hosts) is a memory problem before
+it is a comm problem — at scale the footprint of the iteration state,
+not the matvec FLOPs, is the ceiling (PERF.md §15, arXiv:2105.03874) —
+and nothing before this pass stopped a backend from silently
+materializing an O(E) temporary, dropping a donation into a copy, or
+replicating the full edge table on every host.
+
+For every registered backend it reuses the pass-8 lowering machinery
+(``comm.lowering``: real converge entry points compiled under the
+8-device CPU mesh, sharded composites at TWO scales where E grows 4x
+vs N's 2x — the executables are compiled once and shared with pass 8),
+reads the buffer-assignment view captured at compile time
+(``compiled.memory_analysis()``; conservative live-range walk over the
+optimized HLO as fallback, ``memory/liveness.py``), and checks the
+declarative :data:`~protocol_tpu.analysis.budget.MEM_INVARIANTS`
+budget the kernel module declared:
+
+- **shard-replicated-edges** — per-device resident (argument) bytes
+  exceed the allowance whose edge term is ``E / n_shards``: an edge
+  operand replicated across the mesh busts the formula by
+  construction, caught here before ROADMAP item 1 makes it a
+  2 GB/host mistake;
+- **o-e-live-temporary** — transient live bytes (temp arena +
+  unaliased outputs) exceed the N/n_segments/rows-linear allowance.
+  The transient budget has NO edge coefficient, so a second O(E)-sized
+  live buffer beyond the resident plan arrays is structurally
+  inexpressible — and the committed budgets are pinned tight enough
+  (slack below 4 B/edge at every compiled scale, enforced by test)
+  that one cannot hide in a padded constant either;
+- **donation-peak-doubled** — a declared donated seed whose aliasing
+  did not materialize in the buffer assignment: the dropped alias
+  shows up as a doubled f32[N] carry (4 MB extra at the 1M-peer
+  shape, silent until HBM pressure);
+- **host-staging-over-cap** — a transfer custom-call (or infeed /
+  outfeed / send / recv) carrying more bytes than the per-op staging
+  cap: an O(E) host staging copy has no place in a converge module.
+
+Pass 12 also owns two AST rules over the long-lived node trees
+(``ast_rules.run_mem_ast_pass``): ``host-materialization-of-edges``
+and ``unbounded-cache-growth``.  Registry housekeeping mirrors passes
+1/8 (``undeclared-mem-budget`` / ``no-mem-recipe`` /
+``stale-mem-budget``), and the enumerated waiver table
+(``memory/waivers.py``) is stale-tested in every run that evaluates
+it — pass-7 doctrine.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..budget import MEM_INVARIANTS, NON_JAX_BACKENDS, MemBudget
+from ..report import Finding
+from ..comm.hlo_walk import parse_module
+from ..comm.lowering import COMM_BUILDERS, CommCase, build_cases
+from .liveness import largest_temp_site, measured_view
+from .waivers import MEM_WAIVERS
+
+
+def _finding(rule: str, message: str, backend: str | None = None,
+             file: str | None = None, line: int | None = None,
+             severity: str = "error") -> Finding:
+    return Finding(
+        pass_name="memory", rule=rule, severity=severity, message=message,
+        backend=backend, file=file, line=line,
+    )
+
+
+def check_mem_case(budget: MemBudget, case: CommCase) -> tuple[list[Finding], dict]:
+    """Evaluate one backend-at-one-scale executable against its memory
+    budget.  Returns ``(findings, scale record)`` — the record feeds
+    the per-backend ``memory`` section of ANALYSIS.json."""
+    findings: list[Finding] = []
+    dims = case.dims
+    n = dims.get("n", 0)
+    edges = dims.get("edges", 0)
+    segs = dims.get("n_segments", 0)
+    rows = dims.get("n_rows", 0)
+    shards = dims.get("n_shards", 1)
+    scale = f"N={n}/E={edges}"
+
+    view, source = measured_view(case)
+    max_resident = budget.max_resident(n, edges, segs, rows, shards)
+    max_transient = budget.max_transient(n, segs, rows)
+
+    if view["resident_bytes"] > max_resident:
+        findings.append(_finding(
+            "shard-replicated-edges",
+            f"per-device resident bytes {view['resident_bytes']} at {scale} "
+            f"exceed the E/n_shards-scaled allowance of {max_resident:.0f} B "
+            f"(resident_edge_bytes={budget.resident_edge_bytes}/"
+            f"{shards} shards, resident_n={budget.resident_n}, "
+            f"resident_segments={budget.resident_segments}, "
+            f"resident_rows={budget.resident_rows}) — an edge-sized "
+            f"operand is replicated instead of sharded, the per-host "
+            f"footprint ROADMAP item 1 cannot afford",
+            case.backend,
+        ))
+    if view["transient_bytes"] > max_transient:
+        site = largest_temp_site(case.module_text)
+        findings.append(_finding(
+            "o-e-live-temporary",
+            f"transient live bytes {view['transient_bytes']} at {scale} "
+            f"exceed the N/n_segments-linear allowance of "
+            f"{max_transient:.0f} B (transient_n={budget.transient_n}, "
+            f"transient_segments={budget.transient_segments}, "
+            f"transient_rows={budget.transient_rows}, "
+            f"transient_const={budget.transient_const}) — an edge-scale "
+            f"buffer is live beyond the resident plan arrays; largest "
+            f"temp: {site.bytes if site else '?'} B "
+            f"{site.op if site else ''}",
+            case.backend,
+            site.file if site else None,
+            site.line if site else None,
+        ))
+
+    # Donation must materialize as buffer aliasing: each declared
+    # donated argument is an f32[N] seed, so the alias total must cover
+    # 4*N per entry or the carry is doubled.
+    if budget.donated_args:
+        expected = 4.0 * n * len(budget.donated_args)
+        alias = float(view.get("alias_bytes", 0))
+        if alias < expected:
+            findings.append(_finding(
+                "donation-peak-doubled",
+                f"declared donated seed(s) {budget.donated_args} alias only "
+                f"{alias:.0f} B of the expected {expected:.0f} B at {scale} "
+                f"— the donation died in the buffer assignment and the "
+                f"f32[N] carry is doubled (4 MB extra at the 1M-peer "
+                f"shape, silent until HBM pressure)",
+                case.backend,
+            ))
+
+    # Host staging: any transfer op over the per-op cap is an O(E)
+    # staging copy that has no place in a converge module.
+    cap = budget.staging_cap(n)
+    host_calls = parse_module(case.module_text).host_calls
+    for call in host_calls:
+        if call.bytes > cap:
+            findings.append(_finding(
+                "host-staging-over-cap",
+                f"host transfer {call.target or call.op!r} carries "
+                f"{call.bytes} B at {scale}, over the staging cap of "
+                f"{cap:.0f} B — edge-scale bytes crossing the host "
+                f"boundary outside plan build",
+                case.backend, call.file, call.line,
+            ))
+
+    record = {
+        "scale": scale,
+        "dims": dims,
+        "source": source,
+        "measured": view,
+        "budget_resident_bytes": max_resident,
+        "budget_transient_bytes": max_transient,
+        "budget_peak_bytes": max_resident + max_transient,
+        "staging_cap_bytes": cap,
+        "host_transfers": [h.to_dict() for h in host_calls],
+        "violations": len(findings),
+    }
+    return findings, record
+
+
+def _apply_waivers(findings: list[Finding]) -> tuple[list[Finding], list[dict], list[dict]]:
+    """Split findings into (live, waived records, stale records) using
+    the enumerated MEM_WAIVERS table — pass-7 doctrine."""
+    live: list[Finding] = []
+    waived: list[dict] = []
+    matched: set[int] = set()
+    for f in findings:
+        hit = next(
+            (
+                (i, w)
+                for i, w in enumerate(MEM_WAIVERS)
+                if w.matches(f.rule, f.file or "", f.message)
+            ),
+            None,
+        )
+        if hit is None:
+            live.append(f)
+        else:
+            matched.add(hit[0])
+            waived.append({
+                "rule": f.rule, "file": f.file, "line": f.line,
+                "symbol": hit[1].symbol, "reason": hit[1].reason,
+            })
+    stale = [
+        {"symbol": w.symbol, "rule": w.rule, "reason": w.reason}
+        for i, w in enumerate(MEM_WAIVERS)
+        if i not in matched
+    ]
+    return live, waived, stale
+
+
+def run_memory_pass(
+    backends: list[str] | None = None,
+) -> tuple[list[Finding], dict[str, Any]]:
+    """Compile (or reuse pass 8's executables for) every registered
+    backend and check MEM_INVARIANTS, then run the pass-12 AST rules
+    over the long-lived node trees.  Returns ``(findings, memory
+    section)`` for ANALYSIS.json."""
+    # Importing the registry imports the kernel modules, which declare
+    # their memory budgets next to their kernel/comm budgets.
+    from ...parallel import sharded  # noqa: F401  (declares sharded budgets)
+    from ...trust.backend import registered_backends
+
+    registry = registered_backends()
+    targets = registry if backends is None else backends
+    findings: list[Finding] = []
+    section: dict[str, Any] = {"backends": {}}
+
+    for name in targets:
+        if name in NON_JAX_BACKENDS:
+            section["backends"][name] = {
+                "status": "skipped", "reason": "non-jax backend",
+            }
+            continue
+        budget = MEM_INVARIANTS.get(name)
+        if budget is None:
+            section["backends"][name] = {"status": "undeclared"}
+            findings.append(_finding(
+                "undeclared-mem-budget",
+                f"registered backend {name!r} declares no memory budget; "
+                "add a MEM_INVARIANTS declaration next to its kernel (the "
+                "same policy as kernel and comm budgets, PERF.md §19)",
+                name,
+            ))
+            continue
+        if name not in COMM_BUILDERS:
+            section["backends"][name] = {"status": "no-recipe"}
+            findings.append(_finding(
+                "no-mem-recipe",
+                f"memory budget declared for {name!r} but the analyzer has "
+                "no lowering recipe; coverage would be vacuous",
+                name,
+            ))
+            continue
+        try:
+            cases = build_cases(name)
+        except Exception as exc:  # noqa: BLE001 - report, don't crash the gate
+            section["backends"][name] = {
+                "status": "lowering-failed", "error": repr(exc),
+            }
+            findings.append(_finding(
+                "mem-lowering-failure",
+                f"compiling the step failed: {exc!r}",
+                name,
+            ))
+            continue
+        records = []
+        n_violations = 0
+        for case in cases:
+            case_findings, record = check_mem_case(budget, case)
+            findings.extend(case_findings)
+            n_violations += len(case_findings)
+            records.append(record)
+        section["backends"][name] = {
+            "status": "checked",
+            "scales": records,
+            "violations": n_violations,
+            "budget": {
+                "resident_edge_bytes": budget.resident_edge_bytes,
+                "resident_n": budget.resident_n,
+                "resident_segments": budget.resident_segments,
+                "resident_rows": budget.resident_rows,
+                "resident_const": budget.resident_const,
+                "transient_n": budget.transient_n,
+                "transient_segments": budget.transient_segments,
+                "transient_rows": budget.transient_rows,
+                "transient_const": budget.transient_const,
+                "donated_args": list(budget.donated_args),
+                "staging_n": budget.staging_n,
+                "staging_const": budget.staging_const,
+                "notes": budget.notes,
+            },
+        }
+
+    # Budgets for names no longer in the registry rot silently.
+    if backends is None:
+        for name in sorted(set(MEM_INVARIANTS) - set(registry)):
+            findings.append(_finding(
+                "stale-mem-budget",
+                f"memory budget declared for {name!r} which is not a "
+                "registered backend",
+                name, severity="warning",
+            ))
+
+    # The pass-12 AST rules: host materialization of edge-scale arrays
+    # on the epoch loop's critical path, and unbounded cache growth in
+    # long-lived node classes.
+    if backends is None:
+        from ..ast_rules import run_mem_ast_pass
+
+        ast_findings, n_files = run_mem_ast_pass()
+        findings.extend(ast_findings)
+        section["files_scanned"] = n_files
+
+    live, waived, stale = _apply_waivers(findings)
+    if backends is not None:
+        # A backend-subset run never evaluates the AST leg, so the
+        # staleness of an AST-rule waiver cannot be judged there —
+        # only waivers whose domain this run covered may go stale.
+        from ..ast_rules import MEM_AST_RULES
+
+        stale = [s for s in stale if s["rule"] not in MEM_AST_RULES]
+    for entry in stale:
+        # A dead waiver is itself a gate failure — pass-7 doctrine,
+        # enforced in every run that evaluates its table.
+        live.append(_finding(
+            "stale-waiver",
+            f"memory waiver {entry['symbol']!r} ({entry['rule']}) matches "
+            "no live finding; a fixed leak must take its waiver with it",
+            None,
+        ))
+    section["waived"] = waived
+    section["stale_waivers"] = stale
+    return live, section
+
+
+__all__ = ["check_mem_case", "run_memory_pass"]

@@ -1,0 +1,35 @@
+"""Explicit pass-12 waivers — same doctrine as the pass-7/pass-8
+tables: every suppression is enumerated with its rationale, emitted
+into ANALYSIS.json's ``memory.waived`` list, and **stale-tested** in
+every run that evaluates the table — a waiver that no longer matches a
+live finding is itself an error (``stale-waiver``), so a fixed leak
+takes its waiver with it.
+"""
+
+from __future__ import annotations
+
+from ..concurrency.waivers import Waiver
+
+#: (rule, file substring, message substring) -> rationale — see
+#: :class:`~protocol_tpu.analysis.concurrency.waivers.Waiver`.
+MEM_WAIVERS: tuple[Waiver, ...] = (
+    Waiver(
+        rule="unbounded-cache-growth",
+        file="protocol_tpu/node/manager.py",
+        symbol="Manager._hash_cache",
+        reason=(
+            "The Poseidon pk-hash memo is bounded by the PEER SET, not "
+            "by time: it holds exactly one entry per public key the "
+            "node has ever verified, the same population (and the same "
+            "lifetime) as the attestation cache that IS the graph.  "
+            "Evicting it would re-pay 68 field-level Poseidon rounds "
+            "per ingest of a known sender — the 17x admission-plane "
+            "hashing win (PERF.md §13) exists to avoid exactly that.  "
+            "The epoch-keyed caches this rule polices (cached_proofs / "
+            "cached_results grew ring eviction in this PR) leak with "
+            "uptime; this one grows with the graph."
+        ),
+    ),
+)
+
+__all__ = ["MEM_WAIVERS"]

@@ -72,6 +72,11 @@ class Report:
     #: byte volumes at each compiled scale, host round-trips, the
     #: input_output_alias map, and the comm waiver list (analysis/comm/).
     comm: dict[str, Any] = field(default_factory=dict)
+    #: Pass-12 peak-HBM section: per-backend resident/transient byte
+    #: tables at each compiled scale against the MEM_INVARIANTS
+    #: allowances, host-transfer volumes, and the memory waiver list
+    #: (analysis/memory/).
+    memory: dict[str, Any] = field(default_factory=dict)
 
     def extend(self, findings: list[Finding]) -> None:
         self.findings.extend(findings)
@@ -99,6 +104,7 @@ class Report:
             "backends": self.backends,
             "concurrency": self.concurrency,
             "comm": self.comm,
+            "memory": self.memory,
             "findings": [f.to_dict() for f in self.findings],
         }
 

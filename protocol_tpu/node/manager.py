@@ -47,6 +47,16 @@ chaos.declare("ingest.pre_apply", "an accepted attestation about to enter the ca
 chaos.declare("epoch.post_converge", "the fixed point landed, before state publish")
 chaos.declare("prover.pre_enqueue", "the epoch proof about to be computed or enqueued")
 
+#: Epochs the in-memory proof cache retains for ``GET /proof/<epoch>``
+#: (graftlint pass 12, ``unbounded-cache-growth``): ~10 min of history
+#: at a 10 s cadence.  Older proofs stay durable in checkpoints; the
+#: serving cache must not grow with uptime.
+PROOF_CACHE_EPOCHS = 64
+
+#: Epochs of ConvergenceResult (full f32[N] fixed point each) kept for
+#: inspection — same ring discipline as ``EpochPipeline.outcomes``.
+RESULT_CACHE_EPOCHS = 16
+
 
 @dataclass
 class ManagerConfig:
@@ -501,9 +511,24 @@ class Manager:
         """Land an asynchronously produced proof in the cache (called
         from a proving-plane dispatcher thread; the dict insert is
         GIL-atomic, same discipline as the attestation cache)."""
-        self.cached_proofs[Epoch(int(epoch_number))] = Proof(
-            pub_ins=list(pub_ins), proof=proof_bytes
+        self.cache_proof(
+            Epoch(int(epoch_number)),
+            Proof(pub_ins=list(pub_ins), proof=proof_bytes),
         )
+
+    def cache_proof(self, epoch: Epoch, proof: Proof) -> None:
+        """Insert one epoch's proof and evict past the retention ring.
+
+        The in-memory proof cache is a SERVING cache, not the durable
+        record (checkpoints persist proofs; the proving plane owns the
+        lifecycle) — before graftlint pass 12 it grew one entry per
+        epoch forever, ~uptime x proof bytes of silent leak at a 10 s
+        cadence.  Oldest-epoch eviction keeps ``GET /proof/<epoch>``
+        serving the recent window while boot recovery and the ring
+        agree on what "recent" means."""
+        self.cached_proofs[epoch] = proof
+        while len(self.cached_proofs) > PROOF_CACHE_EPOCHS:
+            self.cached_proofs.pop(min(self.cached_proofs, key=lambda e: e.number))
 
     def checkpoint_watermark(self) -> int | None:
         """WAL seq the next checkpoint may truncate through — the last
@@ -555,7 +580,7 @@ class Manager:
             proof_bytes = self.prover.prove(pub_ins, witness, seed=seed)
         if __debug__:
             assert self.prover.verify(pub_ins, proof_bytes)
-        self.cached_proofs[epoch] = Proof(pub_ins=pub_ins, proof=proof_bytes)
+        self.cache_proof(epoch, Proof(pub_ins=pub_ins, proof=proof_bytes))
         # Sequential-prove lineage completion: this tick's proof covers
         # every cohort bound at or before this epoch (the async plane
         # does the same from its dispatcher when the proof lands).
@@ -741,6 +766,13 @@ class Manager:
             self.last_peer_hashes = prepared.id_order
             self.last_wal_seq = prepared.wal_seq
         self.cached_results[prepared.epoch] = result
+        # Bounded inspection ring (graftlint pass 12): a ConvergenceResult
+        # holds the full f32[N] fixed point — 4 MB/epoch at 1M peers —
+        # and before the memory wall this dict kept every epoch's
+        # forever (~34 GB/day at a 10 s cadence).  Same ring shape as
+        # EpochPipeline.outcomes.
+        while len(self.cached_results) > RESULT_CACHE_EPOCHS:
+            self.cached_results.pop(min(self.cached_results, key=lambda e: e.number))
         # Convergence health → the /metrics surface: the iteration
         # count, the final residual, and the full device-captured
         # trajectory (one observation per iteration, so the histogram's

@@ -356,9 +356,10 @@ def recover(
         if snapshot.proof_json:
             from ..zk.proof import ProofRaw
 
-            manager.cached_proofs[snapshot.epoch] = ProofRaw.from_json(
-                snapshot.proof_json
-            ).to_proof()
+            manager.cache_proof(
+                snapshot.epoch,
+                ProofRaw.from_json(snapshot.proof_json).to_proof(),
+            )
         manager.restore_warm_state(
             graph=snapshot.graph,
             plan=snapshot.plan,

@@ -413,6 +413,15 @@ DEVICE_MEMORY_DELTA = METRICS.gauge(
     "allocator stats)",
     labelnames=("phase",),
 )
+CONVERGE_PEAK_BYTES = METRICS.gauge(
+    "eigentrust_converge_peak_bytes",
+    "Peak device bytes across the converge phase, by backend: the "
+    "memory_stats watermark where the platform reports allocator "
+    "stats, else the compiled executable's buffer-assignment peak "
+    "(the graftlint pass-12 static view, recorded by tools/mem_probe "
+    "and the watermark watcher)",
+    labelnames=("backend",),
+)
 JOURNAL_EVENTS = METRICS.counter(
     "eigentrust_journal_events_total",
     "Flight-recorder events recorded, by kind",
@@ -633,6 +642,7 @@ __all__ = [
     "SCORE_DRIFT_LINF",
     "RESIDUAL_STALLS",
     "DEVICE_MEMORY_DELTA",
+    "CONVERGE_PEAK_BYTES",
     "JOURNAL_EVENTS",
     "JOURNAL_DROPPED",
     "INGEST_QUEUE_DEPTH",

@@ -149,6 +149,14 @@ class MemoryWatermarkWatcher:
         #: ingest threads), so the first-probe flip must not race.
         self._probe_lock = threading.Lock()
         self._enabled: bool | None = None  # None = not probed yet
+        #: Per-backend converge peaks (ISSUE 15): the highest device
+        #: bytes observed across a converge span per backend, fed
+        #: either from the allocator watermark at span close or
+        #: explicitly (tools/mem_probe.py records the executable's
+        #: buffer-assignment peak on platforms without allocator
+        #: stats).  Guarded by the probe lock — writes come from span
+        #: hooks on several roots.
+        self._converge_peaks: dict[str, int] = {}
 
     def _devices(self):
         import jax
@@ -194,6 +202,30 @@ class MemoryWatermarkWatcher:
         span.attrs["dev_mem_delta_bytes"] = delta
         span.attrs["dev_mem_peak_bytes"] = snap[1]
         _metrics.DEVICE_MEMORY_DELTA.set(delta, phase=span.name)
+        # Per-backend converge peak (ISSUE 15): the converge spans the
+        # trust backends open carry their backend name; the allocator's
+        # high-water mark across the span is the runtime half of the
+        # pass-12 static budget cross-check (tools/mem_probe.py).
+        if span.name == "converge" and "backend" in span.attrs:
+            self.record_converge_peak(str(span.attrs["backend"]), snap[1])
+
+    def record_converge_peak(self, backend: str, peak_bytes: int) -> None:
+        """Record one backend's converge peak (max over observations)
+        onto the ``eigentrust_converge_peak_bytes`` gauge.  Called from
+        the span-close hook where the platform has allocator stats, and
+        explicitly by tools/mem_probe.py with the executable's
+        buffer-assignment peak where it does not."""
+        peak = int(peak_bytes)
+        with self._probe_lock:
+            if peak <= self._converge_peaks.get(backend, -1):
+                return
+            self._converge_peaks[backend] = peak
+        _metrics.CONVERGE_PEAK_BYTES.set(peak, backend=backend)
+
+    def converge_peaks(self) -> dict[str, int]:
+        """Per-backend converge peaks recorded so far (bytes)."""
+        with self._probe_lock:
+            return dict(self._converge_peaks)
 
 
 #: Process-global watermark watcher (wired by obs/__init__).

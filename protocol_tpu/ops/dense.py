@@ -20,7 +20,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..analysis.budget import CommBudget, KernelBudget, declare, declare_comm
+from ..analysis.budget import (
+    CommBudget,
+    KernelBudget,
+    MemBudget,
+    declare,
+    declare_comm,
+    declare_mem,
+)
 
 
 @partial(jax.jit, static_argnames=("num_iter",))
@@ -127,5 +134,23 @@ declare_comm(
     CommBudget(
         backend="tpu-dense",
         notes="single-device scan chunk: no wire, no host traffic",
+    )
+)
+
+#: Peak-HBM budget (graftlint pass 12, PERF.md §19).  Resident: the
+#: dense operator matrix (4 B/entry, dims report entries as "edges")
+#: plus the f32[N] seed.  Transient: the scan chunk ping-pongs one
+#: f32[N] score vector — nothing else stays live.  No donation: the
+#: chunked driver re-feeds ``t`` itself.
+declare_mem(
+    MemBudget(
+        backend="tpu-dense",
+        resident_edge_bytes=4.0,
+        resident_n=4.0,
+        resident_const=4096.0,
+        transient_n=8.0,
+        transient_const=4096.0,
+        notes="matmul scan chunk: matrix + seed resident, one f32[N] "
+        "carry transient",
     )
 )

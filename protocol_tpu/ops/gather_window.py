@@ -57,8 +57,10 @@ from ..analysis.budget import (
     CommBudget,
     GatherBudget,
     KernelBudget,
+    MemBudget,
     declare,
     declare_comm,
+    declare_mem,
 )
 from .sparse import _ds_cumsum_axis1, rowsum_sorted, run_power_iteration
 
@@ -1177,5 +1179,34 @@ declare_comm(
         backend="tpu-windowed",
         donated_args=("t0",),
         notes="single-device fused pipeline: no wire, no host traffic",
+    )
+)
+
+#: Peak-HBM budget (graftlint pass 12, PERF.md §19).  Resident: the
+#: plan's vreg-row tables — ``local`` + ``weight`` at (8, 128) slots x
+#: 4 B each plus ``wid`` = 8196 B/row — the bucket-order segment table
+#: (seg_end + seg_first + seg_perm = 9 B/run), the (n+1) dst pointers
+#: and the three f32[N] vectors.  Transient: the Pallas INTERPRET
+#: compile (the analyzer's CPU mesh) re-expresses the Mosaic kernel as
+#: XLA ops, so its VMEM-analog scratch shows up as ~12 row-table
+#: copies in the temp arena (``transient_rows`` = 12 x 8192); on the
+#: real chip that scratch is VMEM, not HBM.  Rows are a plan-layout
+#: dimension (1024 edge slots each) — there is still no per-edge
+#: transient coefficient, and the committed slack is below a 4 B/edge
+#: temporary (pinned by test).
+declare_mem(
+    MemBudget(
+        backend="tpu-windowed",
+        resident_rows=8196.0,
+        resident_segments=9.0,
+        resident_n=16.0,
+        resident_const=4096.0,
+        transient_rows=98304.0,
+        transient_n=36.0,
+        transient_segments=9.0,
+        transient_const=4096.0,
+        donated_args=("t0",),
+        notes="fused pipeline: plan tables resident; interpret-mode "
+        "kernel scratch ~12x the 8 KB row tables",
     )
 )

@@ -31,8 +31,10 @@ from ..analysis.budget import (
     CommBudget,
     GatherBudget,
     KernelBudget,
+    MemBudget,
     declare,
     declare_comm,
+    declare_mem,
 )
 
 
@@ -351,5 +353,43 @@ declare_comm(
         backend="tpu-csr",
         donated_args=("t0",),
         notes="single-device CSR/cumsum loop: no wire, no host traffic",
+    )
+)
+
+# -- memory budgets (PERF.md §19, graftlint pass 12) ------------------------
+# Measured from the buffer assignment at the analyzer's compile scale
+# (N=1024/E=4073); the committed slack is below a 4 B/edge live
+# temporary, pinned by test.  The single-device kernels' E-sized
+# working vectors (the ``w * t[src]`` contribution stream and its
+# reduction passes) are declared in the transient_n coefficient: at
+# the pinned compile scale E ≈ 4N, and the slack test keeps the
+# coefficient honest — a SECOND edge-sized live buffer busts it.
+
+declare_mem(
+    MemBudget(
+        backend="tpu-sparse",
+        resident_edge_bytes=12.0,  # src + dst + w
+        resident_n=12.0,  # t0 + p + dangling
+        resident_const=4096.0,
+        transient_n=36.0,  # contribution stream + segment_sum passes
+        transient_const=8192.0,
+        donated_args=("t0",),
+        notes="segment-sum SpMV: COO triplet resident, E-working set in "
+        "the scatter-add loop",
+    )
+)
+
+declare_mem(
+    MemBudget(
+        backend="tpu-csr",
+        resident_edge_bytes=8.0,  # src + w (row_ptr rides resident_n)
+        resident_n=16.0,  # t0 + p + dangling + (n+1) row pointers
+        resident_const=4096.0,
+        transient_n=445.0,  # contribution stream + cumsum scan levels
+        transient_const=8192.0,
+        donated_args=("t0",),
+        notes="scatter-free CSR: the compensated-cumsum rowsum streams "
+        "log-depth scan levels over the contribution vector (E ~ 4N at "
+        "the pinned scale)",
     )
 )

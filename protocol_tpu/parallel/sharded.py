@@ -62,8 +62,10 @@ from ..analysis.budget import (
     CommBudget,
     GatherBudget,
     KernelBudget,
+    MemBudget,
     declare,
     declare_comm,
+    declare_mem,
 )
 from ..ops.gather_window import (
     BLOCK_ROWS,
@@ -610,5 +612,50 @@ declare_comm(
         donated_args=("t0",),
         notes="sharded fused pipeline: per-shard windowed_ct partials "
         "completed by one f32[N] psum; comm is O(N), never O(E)",
+    )
+)
+
+
+# ---------------------------------------------------------------------------
+# Pinned memory budgets (PERF.md §19) — checked against the compiled
+# module's buffer assignment by graftlint pass 12 at two problem
+# scales (E x4 vs N x2), and at runtime by tools/mem_probe.py.  All
+# numbers are PER DEVICE: the resident edge term is E/n_shards by
+# construction, so a replicated edge operand busts the budget — the
+# regression that turns into 2 GB/host at ROADMAP item 1's 500M-edge
+# target.  The transient allowances were measured to track N across
+# the 4x edge growth (the per-shard working set follows the replicated
+# score vectors, never the edge slice), and the committed slack is
+# below a 4 B/edge temporary at either scale (pinned by test).
+# ---------------------------------------------------------------------------
+
+declare_mem(
+    MemBudget(
+        backend="tpu-sharded:tpu-csr",
+        resident_edge_bytes=8.0,  # per-shard src + w slice
+        resident_n=16.0,  # replicated t0/p/dangling + clipped row_ptr
+        resident_const=4096.0,
+        transient_n=23.0,  # psum buffers + while carries: tracks N, not E
+        transient_const=217792.0,  # runtime-fixed thunk arena, fitted
+        donated_args=("t0",),
+        notes="per-shard CSR slice resident; transient tracked N "
+        "exactly across 4x edge growth (233→257 KB as N doubled)",
+    )
+)
+
+declare_mem(
+    MemBudget(
+        backend="tpu-sharded:tpu-windowed",
+        resident_rows=8196.0,  # per-shard local/weight/wid row tables
+        resident_segments=9.0,  # per-shard seg_end/first/perm
+        resident_n=16.0,  # replicated vectors + clipped dst_ptr
+        resident_const=4096.0,
+        transient_rows=98304.0,  # interpret-mode kernel scratch (12x8KB/row)
+        transient_n=36.0,
+        transient_segments=9.0,
+        transient_const=1118208.0,  # runtime-fixed thunk arena, fitted
+        donated_args=("t0",),
+        notes="per-shard plan slice resident; interpret scratch rides "
+        "rows_per_shard, transient follows N across 4x edge growth",
     )
 )

@@ -21,12 +21,19 @@ python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.
 # partitioner's collectives/bytes/aliasing against COMM_INVARIANTS,
 # sharded composites at two problem scales); pass 11 is the durability
 # ruleset (non-atomic state writes in node/, chaos fault points inside
-# jit/shard_map bodies).  Any error-severity
-# finding — including an unwaived concurrency/comm finding or a STALE
-# waiver in either table — fails here.  Emits ANALYSIS.json (uploaded
-# as a CI artifact; the concurrency and comm sections carry the root
-# inventory, guard map, lock graph, per-backend collective/byte
-# tables, and waiver lists).
+# jit/shard_map bodies); pass 12 is the static peak-HBM analyzer
+# (reads the buffer assignment of the same executables pass 8
+# compiles and gates MEM_INVARIANTS: per-shard resident scaling as
+# E/n_shards, an N-linear transient allowance in which an O(E) live
+# temporary is inexpressible, donation-reduces-peak, host-staging
+# caps, plus the edge-materialization and cache-growth AST rules over
+# node/ and ingest/).  Any error-severity
+# finding — including an unwaived concurrency/comm/memory finding or a
+# STALE waiver in any table — fails here.  Emits ANALYSIS.json
+# (uploaded as a CI artifact; the concurrency, comm, and memory
+# sections carry the root inventory, guard map, lock graph,
+# per-backend collective/byte and resident/transient tables, and
+# waiver lists).
 python -m protocol_tpu.analysis --output ANALYSIS.json
 
 # Trees held to the hard format/type gates: the convergence-kernel,

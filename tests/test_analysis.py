@@ -60,7 +60,7 @@ class TestViolationFixtures:
         finding = errors[0]
         if fixture.marker is None:
             return
-        if fixture.kind in ("ast", "concurrency"):
+        if fixture.kind in ("ast", "concurrency", "mem-ast"):
             # String-sourced fixtures carry their violating code as a
             # source string (so the repo-wide passes never see it); the
             # finding anchors inside that string at the marker line.
@@ -276,7 +276,10 @@ class TestConcurrencyPass:
         to run hard on every lint.  Pass 8 raised the floor: it
         XLA-compiles all six backends (the two Pallas-interpret
         windowed compiles dominate at ~25 s), measured ~45 s total on
-        the 1-core container."""
+        the 1-core container.  The 12-pass run (ISSUE 15) added no
+        compile cost: pass 12 reads the buffer assignment of the SAME
+        executables through the lowering memo (measured ~41 s total),
+        so the ceiling stays put."""
         _, report = real_report
         assert report["_wall_s"] < 120.0, report["_wall_s"]
 

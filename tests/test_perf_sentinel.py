@@ -489,3 +489,85 @@ class TestChaosRecoverySeries:
         report = json.loads(out.read_text())
         assert any("CHAOS_r01.json" in f for f in report["history_files"])
         assert any("recovery_seconds" in k for k in report["series"])
+
+
+class TestMemPeakSeries:
+    def test_mem_rounds_feed_the_gate(self, tmp_path):
+        """ISSUE 15: MEM_r*.json is in the default globs, its
+        ``entries`` list is walked, and peak_hbm_bytes /
+        peak_hbm_bytes_per_shard gate upward (a silently materialized
+        O(E) temporary or a replicated edge operand moves a recorded
+        number before it trips the static wall)."""
+        for i, (peak, per_shard) in enumerate(
+            [(500_000, 60_000), (900_000, 140_000)], start=1
+        ):
+            (tmp_path / f"MEM_r{i:02d}.json").write_text(
+                json.dumps(
+                    {
+                        "n": i,
+                        "tool": "mem_probe",
+                        "entries": [
+                            {
+                                "metric": "converge peak HBM bytes (tpu-csr)",
+                                "peak_hbm_bytes": peak,
+                                "unit": "bytes",
+                            },
+                            {
+                                "metric": (
+                                    "per-shard converge peak HBM bytes "
+                                    "(tpu-sharded:tpu-csr)"
+                                ),
+                                "peak_hbm_bytes_per_shard": per_shard,
+                                "unit": "bytes",
+                            },
+                        ],
+                    }
+                )
+            )
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1  # r02 regressed both series vs r01
+        report = json.loads(out.read_text())
+        assert {
+            "converge peak HBM bytes (tpu-csr) :: peak_hbm_bytes",
+            "per-shard converge peak HBM bytes (tpu-sharded:tpu-csr) "
+            ":: peak_hbm_bytes_per_shard",
+        } <= set(report["regressions"])
+
+    def test_stable_mem_rounds_pass(self, tmp_path):
+        for i in (1, 2):
+            (tmp_path / f"MEM_r{i:02d}.json").write_text(
+                json.dumps(
+                    {
+                        "n": i,
+                        "tool": "mem_probe",
+                        "entries": [
+                            {
+                                "metric": "converge peak HBM bytes (x)",
+                                "peak_hbm_bytes": 500_000,
+                                "unit": "bytes",
+                            }
+                        ],
+                    }
+                )
+            )
+        rc = perf_sentinel.main(
+            ["--history", str(tmp_path), "--out", str(tmp_path / "S.json")]
+        )
+        assert rc == 0
+
+    def test_committed_mem_round_feeds_the_gate(self, tmp_path):
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(REPO), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert any("MEM_r01.json" in f for f in report["history_files"])
+        assert any("peak_hbm_bytes" in k for k in report["series"])
+        assert any("peak_hbm_bytes_per_shard" in k for k in report["series"])
+
+    def test_mem_probe_report_is_not_skipped_as_artifact(self):
+        """MEM rounds carry "tool" but no "findings", so the
+        non-bench-artifact filter must NOT skip them — the COMM_PROBE
+        parity the ISSUE names."""
+        report = {"tool": "mem_probe", "ok": True, "entries": []}
+        assert not perf_sentinel._is_non_bench_artifact(report)

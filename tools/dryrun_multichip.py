@@ -44,11 +44,13 @@ def _next_round_path() -> str:
 
 
 def _scrape(backend: str, lowered) -> dict:
-    """Pass-8 walk of one compiled runner module."""
+    """Pass-8 walk + pass-12 buffer-assignment view of one compiled
+    runner module."""
     from protocol_tpu.analysis.comm.hlo_walk import parse_module
 
-    mod = parse_module(lowered.compile().as_text())
-    return {
+    compiled = lowered.compile()
+    mod = parse_module(compiled.as_text())
+    out = {
         "collectives": [op.to_dict() for op in mod.collectives],
         "bytes_per_iter": mod.total_bytes(per_iteration_only=True),
         "input_output_alias": {
@@ -56,6 +58,20 @@ def _scrape(backend: str, lowered) -> dict:
         },
         "host_round_trips": len(mod.host_calls),
     }
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - absent on some runtimes
+        ma = None
+    if ma is not None:
+        # memory_analysis is the PER-DEVICE view: under the mesh this
+        # is the per-shard footprint the MEM_INVARIANTS budgets pin.
+        out["peak_hbm_bytes_per_shard"] = int(
+            ma.argument_size_in_bytes
+            + ma.output_size_in_bytes
+            - ma.alias_size_in_bytes
+            + ma.temp_size_in_bytes
+        )
+    return out
 
 
 def _body(n_devices: int, n_peers: int, n_edges: int, iters: int) -> dict:
@@ -121,6 +137,20 @@ def _body(n_devices: int, n_peers: int, n_edges: int, iters: int) -> dict:
             "unit": "bytes",
         }
         for backend, scraped in comm.items()
+    ]
+    # Pass-12 series: per-process (= per-shard) converge peaks, so a
+    # PR that inflates the per-host footprint moves a recorded number.
+    entries += [
+        {
+            "metric": (
+                f"per-shard converge peak HBM bytes ({backend}, "
+                f"{n_devices}-dev mesh, {graph.n} peers/{n_edges} edges)"
+            ),
+            "peak_hbm_bytes_per_shard": scraped["peak_hbm_bytes_per_shard"],
+            "unit": "bytes",
+        }
+        for backend, scraped in comm.items()
+        if "peak_hbm_bytes_per_shard" in scraped
     ]
     return {
         "n_devices": n_devices,

@@ -5,8 +5,9 @@ The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
 ``INGEST_r*.json``, since ISSUE 9 the multichip comm rounds
 ``MULTICHIP_r*.json``, since ISSUE 10 the proving-plane rounds
 ``PROVER_r*.json``, since ISSUE 11 the fleet-observability rounds
-``OBS_r*.json``, and since ISSUE 14 the crash-matrix rounds
-``CHAOS_r*.json``) but nothing ever *read* the series — a PR could
+``OBS_r*.json``, since ISSUE 14 the crash-matrix rounds
+``CHAOS_r*.json``, and since ISSUE 15 the memory-probe rounds
+``MEM_r*.json``) but nothing ever *read* the series — a PR could
 halve headline throughput and no gate would notice.  This tool closes
 the loop: it parses the recorded rounds into per-metric series
 (headline convergence seconds, cold/steady-state epoch seconds, plan
@@ -74,6 +75,12 @@ _FIELDS = {
     # regress the durability plane.
     "recovery_seconds": True,
     "wal_overhead_pct": True,
+    # Pass-12 memory scrape (MEM_r*/MULTICHIP rounds): measured peak
+    # device bytes of the converge executables, total and per shard —
+    # a silently materialized O(E) temporary or a replicated edge
+    # operand regresses these series upward before it fails the wall.
+    "peak_hbm_bytes": True,
+    "peak_hbm_bytes_per_shard": True,
 }
 
 
@@ -254,7 +261,7 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="history filename glob(s); default: BENCH_r*.json, "
         "LADDER_r*.json, INGEST_r*.json, MULTICHIP_r*.json, "
-        "PROVER_r*.json, and OBS_r*.json",
+        "PROVER_r*.json, OBS_r*.json, CHAOS_r*.json, and MEM_r*.json",
     )
     ap.add_argument(
         "--fresh",
@@ -281,6 +288,7 @@ def main(argv: list[str] | None = None) -> int:
         "PROVER_r*.json",
         "OBS_r*.json",
         "CHAOS_r*.json",
+        "MEM_r*.json",
     ]
     paths = [
         Path(p) for pat in patterns for p in globlib.glob(str(root / pat))
