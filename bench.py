@@ -252,6 +252,7 @@ def headline_entry(
         "metric": f"1M-peer/50M-edge global-trust convergence wall-clock (40 power iters, {backend})",
         "value": round(elapsed, 4),
         "unit": "seconds",
+        "n_hosts": 1,
         "vs_baseline": round(target_seconds / elapsed, 3),
         "phases": phases,
         **extra,
@@ -292,10 +293,10 @@ def epochs_entry(
     """
     import numpy as np
 
+    from protocol_tpu.models.churn import churn_cohort_dims, sender_centric_churn
     from protocol_tpu.models.graphs import scale_free
     from protocol_tpu.obs.metrics import PLAN_OUTCOMES
     from protocol_tpu.trust.backend import get_backend
-    from protocol_tpu.trust.graph import TrustGraph
 
     rng = np.random.default_rng(seed)
     graph = scale_free(n_peers, n_edges, seed=seed).drop_self_edges()
@@ -323,28 +324,12 @@ def epochs_entry(
     cur = graph
     delta0 = PLAN_OUTCOMES.value(outcome="delta")
     rebuild0 = PLAN_OUTCOMES.value(outcome="rebuild")
-    avg_deg = max(cur.nnz / n_peers, 1.0)
-    cohort_size = max(1, int(round(churn * cur.nnz / avg_deg)))
-    deg = max(1, int(round(avg_deg)))
+    cohort_size, deg = churn_cohort_dims(cur, churn)
     for k in range(1, epochs):
-        # Recency-biased re-attesting cohort: ids exponential toward
-        # the top of the id space (first-seen order ⇒ newest peers).
-        offs = rng.exponential(
-            scale=max(n_peers * 0.02, cohort_size), size=cohort_size
-        ).astype(np.int64)
-        rows = np.unique(n_peers - 1 - np.minimum(offs, n_peers - 1))
-        keep = ~np.isin(cur.src, rows.astype(np.int32))
-        ns = np.repeat(rows.astype(np.int32), deg)
-        nd = rng.integers(0, n_peers, ns.shape[0]).astype(np.int32)
-        while (bad := nd == ns).any():  # no self-edges
-            nd[bad] = rng.integers(0, n_peers, int(bad.sum()))
-        nw = rng.integers(1, 1000, ns.shape[0]).astype(np.float32)
-        cur = TrustGraph(
-            cur.n,
-            np.concatenate([cur.src[keep], ns]),
-            np.concatenate([cur.dst[keep], nd]),
-            np.concatenate([cur.weight[keep], nw]),
-            cur.pre_trusted,
+        # Recency-biased re-attesting cohort (models.churn — the
+        # shared sender-centric stream the pod dryrun replays too).
+        rows, cur, _ = sender_centric_churn(
+            rng, cur, cohort_size=cohort_size, deg=deg
         )
         if hasattr(b, "delta_rows"):
             b.delta_rows = rows
@@ -370,6 +355,7 @@ def epochs_entry(
         ),
         "value": round(steady_state_epoch_seconds, 4),
         "unit": "seconds",
+        "n_hosts": 1,
         "epochs": epochs,
         "churn": churn,
         "cold_epoch_seconds": round(cold_epoch_seconds, 4),

@@ -163,6 +163,15 @@ class CommBudget:
     #: Host round-trips (infeed/outfeed/send/recv/host-callback
     #: custom-calls) permitted in the compiled module.
     max_host_round_trips: int = 0
+    #: Require every lowered collective's replica groups to form ONE
+    #: group spanning all ``n_shards`` devices (pod doctrine: the
+    #: boundary-completing psum must cover the whole mesh — a
+    #: partitioner that splits it into per-host subgroups leaves rows
+    #: whose runs straddle hosts incomplete, a silent wrong-result,
+    #: and a hierarchical reduce that *re-covers* the mesh shows up as
+    #: extra collectives under the count caps above).  Groups the HLO
+    #: leaves empty mean "all devices" and pass.
+    require_full_replica_group: bool = False
     #: Arguments whose donation must survive all the way into the
     #: compiled module's ``input_output_alias`` table (a dropped alias
     #: doubles peak HBM at the 1M-peer shape and ships silently).

@@ -39,7 +39,7 @@ from typing import Any
 
 from ..budget import COLLECTIVE_KINDS, COMM_INVARIANTS, NON_JAX_BACKENDS, CommBudget
 from ..report import Finding
-from .hlo_walk import parse_module
+from .hlo_walk import parse_module, replica_group_sizes
 from .lowering import COMM_BUILDERS, CommCase, build_cases
 from .waivers import COMM_WAIVERS
 
@@ -93,6 +93,26 @@ def check_comm_case(budget: CommBudget, case: CommCase) -> tuple[list[Finding], 
                 f"budget of {allowed}",
                 case.backend, site.file, site.line,
             ))
+
+    # Replica-group coverage (pod doctrine): every collective must span
+    # the whole shard mesh in ONE group.  A per-host subgroup on the
+    # boundary-completing psum leaves rows whose runs straddle hosts
+    # incomplete — wrong scores, not just wrong bytes — and empty
+    # groups (HLO's "all devices" shorthand) pass.
+    if budget.require_full_replica_group:
+        n_shards = dims.get("n_shards", 1)
+        for op in mod.collectives:
+            sizes = replica_group_sizes(op.replica_groups)
+            if sizes and (len(sizes) != 1 or sizes[0] != n_shards):
+                findings.append(_finding(
+                    "replica-group-coverage",
+                    f"{op.kind} at {scale} partitions the mesh into "
+                    f"groups of {sizes} instead of one {n_shards}-device "
+                    f"group (replica_groups={op.replica_groups}) — a "
+                    f"subgroup reduce completes only a subset of the "
+                    f"boundary rows",
+                    case.backend, op.file, op.line,
+                ))
 
     # Byte budget, per-iteration ops only (one-time resharding outside
     # the while loop is judged by kind/count above).
@@ -284,6 +304,7 @@ def run_comm_pass(
                 "bytes_shards": budget.bytes_shards,
                 "bytes_const": budget.bytes_const,
                 "max_host_round_trips": budget.max_host_round_trips,
+                "require_full_replica_group": budget.require_full_replica_group,
                 "donated_args": list(budget.donated_args),
                 "notes": budget.notes,
             },

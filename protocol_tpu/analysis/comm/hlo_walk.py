@@ -184,6 +184,21 @@ class ModuleComm:
         return set(self.aliases.values())
 
 
+def replica_group_sizes(groups: str) -> list[int]:
+    """Sizes of each replica group in an HLO ``replica_groups`` literal:
+    ``{{0,1,2,3},{4,5,6,7}}`` -> ``[4, 4]``; the empty literal (``{}``
+    or missing — HLO shorthand for "all devices in one group") ->
+    ``[]``.  Pass 8's multi-host coverage rule reads this to assert the
+    boundary-completing psum spans the whole pod mesh rather than a
+    per-host subgroup."""
+    sizes = []
+    for inner in re.findall(r"\{([\d,\s]*)\}", groups):
+        ids = [tok for tok in inner.replace(",", " ").split() if tok]
+        if ids:
+            sizes.append(len(ids))
+    return sizes
+
+
 def _normalize_kind(op: str) -> str:
     """Fold the async ``-start``/``-done`` split back to one op (count
     the start, drop the done — one wire transfer either way)."""
@@ -244,5 +259,6 @@ __all__ = [
     "HostCall",
     "ModuleComm",
     "parse_module",
+    "replica_group_sizes",
     "shape_bytes",
 ]

@@ -69,6 +69,35 @@ def _finding(rule: str, message: str, backend: str | None = None,
     )
 
 
+def pod_budget_view(
+    budget: MemBudget,
+    *,
+    n: int,
+    edges: int,
+    n_segments: int,
+    rows: int,
+    n_shards: int,
+    n_hosts: int = 1,
+) -> dict:
+    """The per-shard HBM allowance at a pod scale: ``n_shards`` is the
+    GLOBAL shard count (``n_hosts × local devices``), ``n_segments``
+    and ``rows`` are the per-host plan's — pod partitioning divides the
+    edge set per host before the local device cut, so the resident edge
+    term divides by the global shard count while the replicated-vector
+    terms stay O(N) per device.  Used by ``check_mem_case`` to record
+    the multi-host projection of every sharded backend and by
+    ``tools/dryrun_pod.py`` to gate each process's measured peak."""
+    resident = budget.max_resident(n, edges, n_segments, rows, n_shards)
+    transient = budget.max_transient(n, n_segments, rows)
+    return {
+        "n_hosts": n_hosts,
+        "n_shards": n_shards,
+        "resident_bytes": resident,
+        "transient_bytes": transient,
+        "peak_bytes": resident + transient,
+    }
+
+
 def check_mem_case(budget: MemBudget, case: CommCase) -> tuple[list[Finding], dict]:
     """Evaluate one backend-at-one-scale executable against its memory
     budget.  Returns ``(findings, scale record)`` — the record feeds
@@ -161,6 +190,16 @@ def check_mem_case(budget: MemBudget, case: CommCase) -> tuple[list[Finding], di
         "host_transfers": [h.to_dict() for h in host_calls],
         "violations": len(findings),
     }
+    if shards > 1:
+        # Multi-host projection: the same budget evaluated with the
+        # shard count a 2-host pod doubles to — the edge term halves
+        # per shard, everything O(N) stays — recorded so ANALYSIS.json
+        # states the pod's per-shard allowance next to the single-host
+        # measurement (the dryrun gates the measured side).
+        record["pod_projection"] = pod_budget_view(
+            budget, n=n, edges=edges, n_segments=segs, rows=rows,
+            n_shards=shards * 2, n_hosts=2,
+        )
     return findings, record
 
 
@@ -320,4 +359,4 @@ def run_memory_pass(
     return live, section
 
 
-__all__ = ["check_mem_case", "run_memory_pass"]
+__all__ = ["check_mem_case", "pod_budget_view", "run_memory_pass"]

@@ -283,6 +283,15 @@ class CheckpointStore:
             if p.stem.removeprefix("epoch_").isdigit()
         ]
 
+    def manifest_entry(self, epoch: Epoch) -> dict | None:
+        """This epoch's manifest entry (column/plan digests + WAL
+        watermark) — what a pod host binds into its shard stamp
+        (``node.pod.PodDurability.publish_shard``): the stamp quotes
+        the digests the store itself verifies on load, so manifest
+        verification and snapshot verification can never disagree."""
+        entry = self._read_manifest().get("epochs", {}).get(str(epoch.number))
+        return entry if isinstance(entry, dict) else None
+
     # -- load -----------------------------------------------------------
 
     def load(self, epoch: Epoch) -> Snapshot:

@@ -96,6 +96,16 @@ class ManagerConfig:
     #: delta update and rebuilds from scratch: past this crossover the
     #: per-window repack costs more than the full counting sorts.
     plan_delta_max_churn: float = 0.05
+    #: Pod membership (ROADMAP item 1): with ``pod_hosts > 1`` this
+    #: node owns only the peers the rendezvous partition assigns to
+    #: ``pod_host_id``, and ``prepare_epoch`` clips the plan-delta
+    #: churn hint to owned rows — churn on other hosts' peers never
+    #: touches this host's plan (``parallel.partition``).
+    pod_hosts: int = 1
+    pod_host_id: int = 0
+    #: Salt namespace for the pod's peer→host partition; every host in
+    #: one pod must configure the same value.
+    pod_seed: int = 0
 
 
 @dataclass(frozen=True)
@@ -603,18 +613,35 @@ class Manager:
         # matched pair or the warm seed maps scores onto wrong peers.
         with self._state_lock:
             scores, hashes = self.last_scores, self.last_peer_hashes
-        if scores is None or hashes is None:
+        if scores is None or hashes is None or not len(hashes) or not len(scores):
             return None
-        prev = {h: i for i, h in enumerate(hashes)}
-        t0 = np.zeros(len(id_order), np.float64)
-        hits = 0
-        for i, h in enumerate(id_order):
-            j = prev.get(h)
-            if j is not None and j < len(scores):
-                t0[i] = max(float(scores[j]), 0.0)
-                hits += 1
+        # Vectorized remap (PERF.md §20): the per-peer dict walk cost
+        # ~7 s of pure Python at the pod's 10M-peer scale; folding the
+        # Poseidon hashes to 64-bit keys and matching via one sorted
+        # searchsorted pass is ~30x faster.  A low-64-bit collision
+        # (≈ n²/2⁶⁴ odds) can only misplace one seed entry — the seed
+        # is renormalized and the fixed point is start-independent, so
+        # the failure mode is a marginally longer converge, never a
+        # wrong score.
+        from ..parallel.partition import keys_from_hashes
+
+        prev_keys = keys_from_hashes(hashes)
+        new_keys = keys_from_hashes(id_order)
+        order = np.argsort(prev_keys, kind="stable")
+        sorted_prev = prev_keys[order]
+        pos = np.searchsorted(sorted_prev, new_keys)
+        pos = np.minimum(pos, max(len(sorted_prev) - 1, 0))
+        hit = (
+            (sorted_prev[pos] == new_keys)
+            if len(sorted_prev)
+            else np.zeros(len(new_keys), bool)
+        )
+        j = order[pos]
+        hit &= j < len(scores)
+        prev_scores = np.maximum(np.asarray(scores, np.float64), 0.0)
+        t0 = np.where(hit, prev_scores[np.minimum(j, len(scores) - 1)], 0.0)
         total = t0.sum()
-        if hits == 0 or not np.isfinite(total) or total <= 0:
+        if not hit.any() or not np.isfinite(total) or total <= 0:
             return None
         return t0 / total
 
@@ -686,6 +713,18 @@ class Manager:
             rows = np.array(
                 sorted(pos[h] for h in dirty if h in pos), dtype=np.int64
             )
+            # Pod mode: this host's plan only encodes the out-edges of
+            # peers it owns, so churn on other hosts' peers is not a
+            # delta against it — clip the hint to owned rows (the
+            # owned-elsewhere rows are some other host's delta).
+            if rows.size and self.config.pod_hosts > 1:
+                from ..parallel.partition import HostPartition, keys_from_hashes
+
+                part = HostPartition(
+                    self.config.pod_hosts, seed=self.config.pod_seed
+                )
+                keys = keys_from_hashes(id_order[int(r)] for r in rows)
+                rows = rows[part.assign(keys) == self.config.pod_host_id]
             # Above the churn crossover a full rebuild is cheaper than
             # repacking that many windows (PERF.md §11).
             if rows.size and rows.size <= self.config.plan_delta_max_churn * max(

@@ -598,6 +598,55 @@ RPC_RETRIES = METRICS.counter(
     "(exponential backoff + jitter + per-call timeout), by operation",
     labelnames=("op",),
 )
+POD_HOSTS = METRICS.gauge(
+    "eigentrust_pod_hosts",
+    "Hosts (jax.distributed processes) in this node's pod — 1 on a "
+    "single-host deployment; the peer→host rendezvous partition "
+    "(parallel/partition.py) is keyed on this count",
+)
+POD_HOST_ID = METRICS.gauge(
+    "eigentrust_pod_host_id",
+    "This process's host id inside the pod's rendezvous partition",
+)
+POD_OWNED_PEERS = METRICS.gauge(
+    "eigentrust_pod_owned_peers",
+    "Peers whose out-edges (and WAL/checkpoint shard rows) this host "
+    "owns under the pod partition — tracks n/n_hosts when the "
+    "rendezvous hash is balanced",
+)
+POD_LOCAL_EDGES = METRICS.gauge(
+    "eigentrust_pod_local_edges",
+    "Edges in this host's partition (source peer owned here) — the "
+    "host's plan-build and WAL-volume driver; the pod total is the "
+    "graph's edge count",
+)
+POD_PLAN_BUILD_SECONDS = METRICS.gauge(
+    "eigentrust_pod_plan_build_seconds",
+    "Wall-clock of this host's last LOCAL window-plan resolution "
+    "(delta or rebuild over owned edges only; 0 on verbatim reuse) — "
+    "the pod's plan-build critical path is the max across hosts, vs "
+    "the serial full-graph build it replaces (PERF.md §20)",
+)
+POD_PLAN_REUSED = METRICS.counter(
+    "eigentrust_pod_plan_reused_total",
+    "Epochs whose churn was entirely owned by other hosts, so this "
+    "host revalidated its local fingerprint and reused its plan "
+    "verbatim — the partition-locality win, by outcome "
+    "(reuse/delta/rebuild)",
+    labelnames=("outcome",),
+)
+POD_EPOCH_SECONDS = METRICS.gauge(
+    "eigentrust_pod_epoch_seconds",
+    "Steady-state wall-clock of the last pod epoch (plan resolution + "
+    "sharded converge + durability stamp) as this host measured it — "
+    "the flat-vs-single-host headline series of PERF.md §20",
+)
+POD_MANIFESTS_SEALED = METRICS.counter(
+    "eigentrust_pod_manifests_sealed_total",
+    "Pod manifests sealed by this host (sealer role only): epochs "
+    "whose complete per-host shard stamp set was atomically bound "
+    "into pod_manifest_e<N>.json (node/pod.py)",
+)
 LOCK_WAIT_SECONDS = METRICS.histogram(
     "eigentrust_lock_wait_seconds",
     "Lock-acquisition wait time by allocation site — recorded only "
@@ -673,5 +722,13 @@ __all__ = [
     "CHECKPOINT_FALLBACKS",
     "RECOVERY_SECONDS",
     "RPC_RETRIES",
+    "POD_HOSTS",
+    "POD_HOST_ID",
+    "POD_OWNED_PEERS",
+    "POD_LOCAL_EDGES",
+    "POD_PLAN_BUILD_SECONDS",
+    "POD_PLAN_REUSED",
+    "POD_EPOCH_SECONDS",
+    "POD_MANIFESTS_SEALED",
     "LOCK_WAIT_SECONDS",
 ]

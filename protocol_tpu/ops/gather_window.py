@@ -974,6 +974,36 @@ def try_plan_delta(
     return new_plan
 
 
+def partition_delta(
+    rows: np.ndarray | None,
+    src: np.ndarray,
+    dst: np.ndarray,
+    w: np.ndarray,
+    owner: np.ndarray,
+    host: int,
+) -> tuple[np.ndarray | None, np.ndarray, np.ndarray, np.ndarray]:
+    """Clip a churn hint and a row-normalized edge list to one pod
+    host's partition (``owner[i]`` = host owning source peer ``i``,
+    from ``parallel.partition.HostPartition``).
+
+    Edges are owned by their **source** peer, so a dirty row (one
+    sender's rewritten out-edges) is dirty on exactly one host: the
+    returned ``owned_rows`` feed :func:`try_plan_delta` against that
+    host's *local* plan, and hosts owning none of the churn keep their
+    plan verbatim — steady-state churn never forces a cross-host
+    rebuild.  Returns ``(owned_rows, local_src, local_dst, local_w)``;
+    ``owned_rows`` is None when the caller passed no hint (forcing
+    fingerprint-only revalidation, same contract as the global path).
+    """
+    owner = np.asarray(owner)
+    mask = owner[src] == host
+    owned_rows = None
+    if rows is not None:
+        rows = np.unique(np.asarray(rows, np.int64))
+        owned_rows = rows[owner[rows] == host]
+    return owned_rows, src[mask], dst[mask], w[mask]
+
+
 def bridge_partials(
     hi: jax.Array,
     lo: jax.Array,

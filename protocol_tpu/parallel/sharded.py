@@ -212,6 +212,89 @@ def _get_runner(mesh: Mesh, n: int):
     return run
 
 
+def _partition_plan_arrays(
+    plan: WindowPlan,
+    n_shards: int,
+    *,
+    rows_per_shard: int | None = None,
+    s_max: int | None = None,
+) -> dict:
+    """Host-side partition of one ``WindowPlan`` into ``n_shards``
+    contiguous, BLOCK_ROWS-aligned vreg-row slices — the shared cut
+    used by the single-host ``ShardedWindowPlan`` and, per host, by the
+    pod builder (``parallel.pod``).  ``rows_per_shard``/``s_max`` may
+    be forced upward by the caller: a pod must pad every host's
+    partition to the pod-wide maxima so the global shard shapes (and
+    the compiled runner) agree across processes.  Returns the numpy
+    shard tables plus the resolved dimensions."""
+    min_rps = -(-plan.n_rows // (n_shards * BLOCK_ROWS)) * BLOCK_ROWS
+    if rows_per_shard is None:
+        rows_per_shard = min_rps
+    elif rows_per_shard < min_rps or rows_per_shard % BLOCK_ROWS:
+        raise ValueError(
+            f"rows_per_shard={rows_per_shard} cannot hold {plan.n_rows} "
+            f"plan rows over {n_shards} shards (need >= {min_rps}, "
+            f"BLOCK_ROWS-aligned)"
+        )
+    total_rows = n_shards * rows_per_shard
+    wid = np.zeros(total_rows, np.int32)
+    wid[: plan.n_rows] = plan.wid
+    local = np.zeros((total_rows * 8, 128), np.int32)
+    local[: plan.n_rows * 8] = plan.local
+    weight = np.zeros((total_rows * 8, 128), np.float32)
+    weight[: plan.n_rows * 8] = plan.weight
+
+    # Segment table: bucket order is slot order, so the row cuts give
+    # contiguous per-shard slices.  Only the plan's live runs partition
+    # — its device-capacity pads are regenerated here as per-shard
+    # padding.
+    live_end = plan.seg_end[: plan.n_segments]
+    live_first = plan.seg_first[: plan.n_segments]
+    shard_of = (live_end // ROW) // rows_per_shard
+    counts = np.bincount(shard_of, minlength=n_shards)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    # Quantized per-shard run capacity: small per-epoch deltas keep the
+    # sharded array shapes (and the compiled runner) stable.
+    min_smax = -(-max(int(counts.max()), 1) // 1024) * 1024
+    if s_max is None:
+        s_max = min_smax
+    elif s_max < min_smax:
+        raise ValueError(
+            f"s_max={s_max} below this plan's per-shard run count "
+            f"{min_smax}"
+        )
+    # Bucket-order run destinations: stored on the plan since layout v3
+    # (the delta-update bookkeeping keeps it current).
+    seg_dst = plan.seg_dst
+    seg_end = np.zeros((n_shards, s_max), np.int32)
+    seg_first = np.ones((n_shards, s_max), bool)
+    seg_perm = np.zeros((n_shards, s_max), np.int32)
+    dst_ptr = np.zeros((n_shards, plan.n + 1), np.int32)
+    for k in range(n_shards):
+        beg, end = int(offsets[k]), int(offsets[k + 1])
+        sk = end - beg
+        seg_end[k, :sk] = live_end[beg:end] - k * rows_per_shard * ROW
+        seg_first[k, :sk] = live_first[beg:end]
+        # Pad runs stay a valid permutation so XLA's gather cost is
+        # uniform; they land beyond dst_ptr[k, n] and are dropped.
+        seg_perm[k, sk:] = np.arange(sk, s_max, dtype=np.int32)
+        if sk:
+            sperm, dst_counts, _ = _counting_sort(seg_dst[beg:end], plan.n)
+            seg_perm[k, :sk] = sperm
+            np.cumsum(dst_counts, out=dst_ptr[k, 1:])
+    return {
+        "rows_per_shard": rows_per_shard,
+        "s_max": int(s_max),
+        "wid": wid,
+        "local": local,
+        "weight": weight,
+        "seg_end": seg_end,
+        "seg_first": seg_first,
+        "seg_perm": seg_perm,
+        "dst_ptr": dst_ptr,
+    }
+
+
 @dataclass
 class ShardedWindowPlan:
     """Mesh-partitioned fused-pipeline layout: the ``tpu-windowed``
@@ -284,46 +367,8 @@ class ShardedWindowPlan:
                 outcome = "rebuild"
 
         n_shards = mesh.shape[SHARD_AXIS]
-        rows_per_shard = -(-plan.n_rows // (n_shards * BLOCK_ROWS)) * BLOCK_ROWS
-        total_rows = n_shards * rows_per_shard
-        wid = np.zeros(total_rows, np.int32)
-        wid[: plan.n_rows] = plan.wid
-        local = np.zeros((total_rows * 8, 128), np.int32)
-        local[: plan.n_rows * 8] = plan.local
-        weight = np.zeros((total_rows * 8, 128), np.float32)
-        weight[: plan.n_rows * 8] = plan.weight
-
-        # Segment table: bucket order is slot order, so the row cuts
-        # give contiguous per-shard slices.  Only the plan's live runs
-        # partition — its device-capacity pads are regenerated here as
-        # per-shard padding.
-        live_end = plan.seg_end[: plan.n_segments]
-        live_first = plan.seg_first[: plan.n_segments]
-        shard_of = (live_end // ROW) // rows_per_shard
-        counts = np.bincount(shard_of, minlength=n_shards)
-        offsets = np.concatenate([[0], np.cumsum(counts)])
-        # Quantized per-shard run capacity: small per-epoch deltas keep
-        # the sharded array shapes (and the compiled runner) stable.
-        s_max = -(-max(int(counts.max()), 1) // 1024) * 1024
-        # Bucket-order run destinations: stored on the plan since
-        # layout v3 (the delta-update bookkeeping keeps it current).
-        seg_dst = plan.seg_dst
-        seg_end = np.zeros((n_shards, s_max), np.int32)
-        seg_first = np.ones((n_shards, s_max), bool)
-        seg_perm = np.zeros((n_shards, s_max), np.int32)
-        dst_ptr = np.zeros((n_shards, plan.n + 1), np.int32)
-        for k in range(n_shards):
-            beg, end = int(offsets[k]), int(offsets[k + 1])
-            sk = end - beg
-            seg_end[k, :sk] = live_end[beg:end] - k * rows_per_shard * ROW
-            seg_first[k, :sk] = live_first[beg:end]
-            # Pad runs stay a valid permutation so XLA's gather cost is
-            # uniform; they land beyond dst_ptr[k, n] and are dropped.
-            seg_perm[k, sk:] = np.arange(sk, s_max, dtype=np.int32)
-            if sk:
-                sperm, dst_counts, _ = _counting_sort(seg_dst[beg:end], plan.n)
-                seg_perm[k, :sk] = sperm
-                np.cumsum(dst_counts, out=dst_ptr[k, 1:])
+        parts = _partition_plan_arrays(plan, n_shards)
+        rows_per_shard, s_max = parts["rows_per_shard"], parts["s_max"]
 
         edge = NamedSharding(mesh, P(SHARD_AXIS))
         edge2d = NamedSharding(mesh, P(SHARD_AXIS, None))
@@ -337,13 +382,13 @@ class ShardedWindowPlan:
             table_entries=plan.table_entries,
             s_max=s_max,
             interpret=bool(interpret),
-            wid=jax.device_put(wid, edge),
-            local=jax.device_put(local, edge2d),
-            weight=jax.device_put(weight, edge2d),
-            seg_end=jax.device_put(seg_end.reshape(-1), edge),
-            seg_first=jax.device_put(seg_first.reshape(-1), edge),
-            seg_perm=jax.device_put(seg_perm.reshape(-1), edge),
-            dst_ptr=jax.device_put(dst_ptr, edge2d),
+            wid=jax.device_put(parts["wid"], edge),
+            local=jax.device_put(parts["local"], edge2d),
+            weight=jax.device_put(parts["weight"], edge2d),
+            seg_end=jax.device_put(parts["seg_end"].reshape(-1), edge),
+            seg_first=jax.device_put(parts["seg_first"].reshape(-1), edge),
+            seg_perm=jax.device_put(parts["seg_perm"].reshape(-1), edge),
+            dst_ptr=jax.device_put(parts["dst_ptr"], edge2d),
             p=jax.device_put(graph.pre_trust_vector(), repl),
             dangling=jax.device_put(dangling.astype(np.float32), repl),
             plan=plan,
@@ -485,7 +530,11 @@ def converge_sharded(
             np.asarray(t0, np.float32), NamedSharding(problem.mesh, P())
         )
     )
-    if isinstance(problem, ShardedWindowPlan):
+    # Dispatch on the CSR type and treat everything else as windowed-
+    # shaped: the pod builder (``parallel.pod.PodWindowPlan``) carries
+    # the same field layout as ShardedWindowPlan over a multi-process
+    # mesh and rides the identical runner/cache.
+    if not isinstance(problem, ShardedTrustProblem):
         run = _get_windowed_runner(
             problem.mesh,
             problem.n,
@@ -590,6 +639,7 @@ declare_comm(
         bytes_n=8.0,
         bytes_const=1024.0,
         max_host_round_trips=0,
+        require_full_replica_group=True,
         donated_args=("t0",),
         notes="one boundary-completing f32[N] psum per step; comm is "
         "O(N), never O(E)",
@@ -609,6 +659,7 @@ declare_comm(
         bytes_segments=0.0,
         bytes_const=1024.0,
         max_host_round_trips=0,
+        require_full_replica_group=True,
         donated_args=("t0",),
         notes="sharded fused pipeline: per-shard windowed_ct partials "
         "completed by one f32[N] psum; comm is O(N), never O(E)",

@@ -41,7 +41,10 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-#: Devices per worker process; 2 workers -> the analyzer's 8-way mesh.
+#: Defaults: 2 workers x 4 devices -> the analyzer's 8-way mesh.  Both
+#: are CLI-tunable (``--processes``/``--local-devices``) so the pod
+#: dryrun and CI can run probes of different shapes concurrently — the
+#: coordinator port is always picked from a free socket, never fixed.
 LOCAL_DEVICES = 4
 N_PROCESSES = 2
 
@@ -53,11 +56,13 @@ def _free_port() -> int:
 
 
 def _worker(process_id: int, coordinator: str, out_path: str,
-            n_peers: int, n_edges: int) -> int:
+            n_peers: int, n_edges: int,
+            n_processes: int = N_PROCESSES,
+            local_devices: int = LOCAL_DEVICES) -> int:
     """Worker body: distributed init, one sharded converge, self-scrape."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={LOCAL_DEVICES}"
+        f"--xla_force_host_platform_device_count={local_devices}"
     )
     import jax
 
@@ -67,7 +72,7 @@ def _worker(process_id: int, coordinator: str, out_path: str,
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(
             coordinator_address=coordinator,
-            num_processes=N_PROCESSES,
+            num_processes=n_processes,
             process_id=process_id,
         )
     except Exception as exc:  # old jaxlib: no multi-process CPU
@@ -94,7 +99,7 @@ def _worker(process_id: int, coordinator: str, out_path: str,
     n_shards = mesh.shape[SHARD_AXIS]
     result.update(
         backend=backend,
-        n_processes=N_PROCESSES,
+        n_processes=n_processes,
         local_devices=len(jax.local_devices()),
         global_devices=len(jax.devices()),
         n_shards=n_shards,
@@ -160,6 +165,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--out", default="COMM_PROBE.json", help="report path")
     ap.add_argument("--peers", type=int, default=512)
     ap.add_argument("--edges", type=int, default=4096)
+    ap.add_argument(
+        "--processes", type=int, default=N_PROCESSES,
+        help="worker process count (default 2)",
+    )
+    ap.add_argument(
+        "--local-devices", type=int, default=LOCAL_DEVICES,
+        help="forced CPU devices per process (default 4)",
+    )
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--worker", type=int, default=None, help=argparse.SUPPRESS)
     ap.add_argument("--coordinator", default=None, help=argparse.SUPPRESS)
@@ -169,12 +182,14 @@ def main(argv: list[str] | None = None) -> int:
     if args.worker is not None:
         return _worker(
             args.worker, args.coordinator, args.worker_out,
-            args.peers, args.edges,
+            args.peers, args.edges, args.processes, args.local_devices,
         )
 
     coordinator = f"127.0.0.1:{_free_port()}"
     with tempfile.TemporaryDirectory() as tmp:
-        outs = [str(Path(tmp) / f"worker{i}.json") for i in range(N_PROCESSES)]
+        outs = [
+            str(Path(tmp) / f"worker{i}.json") for i in range(args.processes)
+        ]
         procs = [
             subprocess.Popen(
                 [
@@ -184,10 +199,12 @@ def main(argv: list[str] | None = None) -> int:
                     "--worker-out", outs[i],
                     "--peers", str(args.peers),
                     "--edges", str(args.edges),
+                    "--processes", str(args.processes),
+                    "--local-devices", str(args.local_devices),
                 ],
                 cwd=REPO,
             )
-            for i in range(N_PROCESSES)
+            for i in range(args.processes)
         ]
         rcs = []
         for p in procs:
@@ -207,16 +224,16 @@ def main(argv: list[str] | None = None) -> int:
     ok = skipped or (
         all(rc == 0 for rc in rcs) and all(w.get("ok") for w in workers)
     )
-    # Cross-process agreement: both workers hold the full replicated
+    # Cross-process agreement: every worker holds the full replicated
     # result; their residuals must match bit-for-bit-ish.
     if ok and not skipped:
         resids = [w["residual"] for w in workers]
-        if abs(resids[0] - resids[1]) > 1e-9:
+        if max(resids) - min(resids) > 1e-9:
             ok = False
             workers.append({"error": f"residual divergence: {resids}"})
     report = {
         "tool": "comm_probe",
-        "mesh": f"{N_PROCESSES}x{LOCAL_DEVICES}",
+        "mesh": f"{args.processes}x{args.local_devices}",
         "ok": ok,
         "skipped": skipped,
         "return_codes": rcs,

@@ -695,6 +695,55 @@ def node_phase(args, tmp: Path) -> dict:
     return entry
 
 
+def pod_phase(args, tmp: Path) -> dict:
+    """Host-loss row (ISSUE 16): kill one process of an N-host pod
+    mid-epoch and prove pod recovery.  Delegates to
+    ``tools/dryrun_pod.py --chaos-host-loss`` — a control pod run, a
+    run that ``os._exit``\\ s one worker after its WAL ack but before
+    converge, and a full-pod ``--resume`` that must replay the dead
+    host's WAL shard with zero acknowledged loss and reconverge to a
+    fixed point **bit-identical** to the control run's."""
+    entry: dict = {"point": "pod.host-loss", "fault": "kill -9 host 1 of 2"}
+    out = tmp / "pod_chaos.json"
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(ROOT / "tools" / "dryrun_pod.py"),
+            "--smoke",
+            "--chaos-host-loss",
+            "--skip-reference",
+            "--out", str(out),
+        ],
+        cwd=ROOT,
+        timeout=3000,
+    )
+    entry["seconds"] = round(time.perf_counter() - t0, 3)
+    try:
+        report = json.loads(out.read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        entry.update(ok=False, error=f"no dryrun_pod report: {exc!r}")
+        return entry
+    chaos = report.get("chaos") or {}
+    entry.update(
+        skipped=report.get("skipped", False),
+        crash_host=chaos.get("crash_host"),
+        crash_epoch=chaos.get("crash_epoch"),
+        recovery_seconds=chaos.get("recovery_seconds"),
+        lost_attestations=sum(
+            len(x) for x in chaos.get("lost_acked", []) if x
+        ),
+        fixed_point_matches_control=chaos.get("fixed_point_matches_control"),
+        residual_bit_identity=(chaos.get("residual_bit_identity") or {}).get("ok"),
+        # A jax build without multi-process CPU collectives skips the
+        # row without failing the matrix — same policy as comm_probe.
+        ok=bool(report.get("skipped") or (proc.returncode == 0 and chaos.get("ok"))),
+    )
+    if not entry["ok"]:
+        entry["error"] = f"dryrun_pod rc={proc.returncode}, chaos={chaos.get('ok')}"
+    return entry
+
+
 # ---------------------------------------------------------------------------
 # main
 # ---------------------------------------------------------------------------
@@ -715,6 +764,7 @@ def main(argv=None) -> int:
     ap.add_argument("--no-wal", dest="wal", action="store_false", default=True)
     ap.add_argument("--no-fsync", dest="fsync", action="store_false", default=True)
     ap.add_argument("--skip-node-phase", action="store_true")
+    ap.add_argument("--skip-pod-phase", action="store_true")
     ap.add_argument("--replay-delay-s", type=float, default=0.4)
     ap.add_argument("--round", type=int, default=1)
     ap.add_argument("--out", default="CHAOS_smoke.json")
@@ -807,6 +857,8 @@ def main(argv=None) -> int:
 
     if not args.skip_node_phase:
         entries.append(node_phase(args, tmp))
+    if not args.skip_pod_phase:
+        entries.append(pod_phase(args, tmp))
 
     recoveries = [
         e["recovery"]["seconds"] for e in entries if isinstance(e.get("recovery"), dict)

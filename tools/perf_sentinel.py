@@ -6,8 +6,9 @@ The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
 ``MULTICHIP_r*.json``, since ISSUE 10 the proving-plane rounds
 ``PROVER_r*.json``, since ISSUE 11 the fleet-observability rounds
 ``OBS_r*.json``, since ISSUE 14 the crash-matrix rounds
-``CHAOS_r*.json``, and since ISSUE 15 the memory-probe rounds
-``MEM_r*.json``) but nothing ever *read* the series — a PR could
+``CHAOS_r*.json``, since ISSUE 15 the memory-probe rounds
+``MEM_r*.json``, and since ISSUE 16 the pod scale-out rounds
+``POD_r*.json``) but nothing ever *read* the series — a PR could
 halve headline throughput and no gate would notice.  This tool closes
 the loop: it parses the recorded rounds into per-metric series
 (headline convergence seconds, cold/steady-state epoch seconds, plan
@@ -21,6 +22,10 @@ Series are keyed by the exact ``metric`` string plus the field name,
 so differently-shaped runs (CI smoke vs the recorded 1M-peer rounds)
 never get compared against each other; a fresh entry with no matching
 history is reported as ``no-baseline`` and cannot fail the gate.
+Multi-host entries additionally key on ``n_hosts`` (``[n_hosts=N]``
+suffix for N > 1): a 2-host pod number and a single-host number for
+the same metric string are different series by construction —
+single-host entries keep their historical keys unsuffixed.
 
 Directionality: ``*seconds*`` metrics regress upward, throughput
 metrics (``*/s``, ``*per_sec*``) regress downward.
@@ -81,7 +86,25 @@ _FIELDS = {
     # operand regresses these series upward before it fails the wall.
     "peak_hbm_bytes": True,
     "peak_hbm_bytes_per_shard": True,
+    # Pod scale-out rounds (POD_r*.json): the pod's plan-build critical
+    # path (max per-host partition build — the PERF.md §11 serial
+    # bottleneck, attacked by host-sharding) and its speedup over the
+    # serial full-graph build.
+    "plan_build_seconds": True,
+    "plan_build_speedup": False,
 }
+
+
+def _series_key(entry: dict[str, Any], fld: str) -> str:
+    """``<metric> :: <field>`` plus an ``[n_hosts=N]`` marker for
+    multi-host entries — pod rounds never collide with a single-host
+    series for the same metric string, while ``n_hosts: 1`` (and
+    legacy entries without the field) keep their historical keys."""
+    key = f"{entry['metric']} :: {fld}"
+    n_hosts = entry.get("n_hosts")
+    if isinstance(n_hosts, int) and n_hosts > 1:
+        key += f" [n_hosts={n_hosts}]"
+    return key
 
 
 def _lower_is_better(field: str, entry: dict[str, Any]) -> bool | None:
@@ -166,7 +189,7 @@ def collect_series(paths: list[Path]) -> dict[str, list[dict[str, Any]]]:
                 direction = _lower_is_better(fld, entry)
                 if direction is None:
                     continue
-                key = f"{entry['metric']} :: {fld}"
+                key = _series_key(entry, fld)
                 series.setdefault(key, []).append(
                     {
                         "round": rnd,
@@ -243,7 +266,7 @@ def load_fresh(path: Path) -> dict[str, float]:
                 continue
             if _lower_is_better(fld, entry) is None:
                 continue
-            out[f"{entry['metric']} :: {fld}"] = float(val)
+            out[_series_key(entry, fld)] = float(val)
     return out
 
 
@@ -261,7 +284,8 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="history filename glob(s); default: BENCH_r*.json, "
         "LADDER_r*.json, INGEST_r*.json, MULTICHIP_r*.json, "
-        "PROVER_r*.json, OBS_r*.json, CHAOS_r*.json, and MEM_r*.json",
+        "PROVER_r*.json, OBS_r*.json, CHAOS_r*.json, MEM_r*.json, "
+        "and POD_r*.json",
     )
     ap.add_argument(
         "--fresh",
@@ -289,6 +313,7 @@ def main(argv: list[str] | None = None) -> int:
         "OBS_r*.json",
         "CHAOS_r*.json",
         "MEM_r*.json",
+        "POD_r*.json",
     ]
     paths = [
         Path(p) for pat in patterns for p in globlib.glob(str(root / pat))
