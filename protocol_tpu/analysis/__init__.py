@@ -29,6 +29,14 @@ This subsystem checks them by machine:
   temporary is structurally inexpressible, donation-reduces-peak,
   host-staging byte caps), plus the edge-materialization and
   cache-growth AST rules over the long-lived node trees.
+- **Pass 13** (``determinism``): the divergence analyzer — an AST
+  taint walk over the trees feeding bit-identity sinks (set-order
+  materialization, unsorted directory scans, ``hash()``/``id()``
+  keys, unseeded RNGs, wall-clock-in-digest) plus an HLO leg over the
+  same executables passes 8/12 compile asserting replay-stability
+  (no nondeterministic scatter, no reduce-precision, double-compile
+  canonical-diff), with its own stale-tested waiver table.  The
+  runtime half is ``tools/divergence_probe.py``.
 
 Run as ``python -m protocol_tpu.analysis``: emits ``ANALYSIS.json``
 plus ``file:line`` findings; any error-severity finding exits non-zero

@@ -41,7 +41,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--pass",
         dest="passes",
-        choices=("all", "jaxpr", "ast", "concurrency", "comm", "memory"),
+        choices=("all", "jaxpr", "ast", "concurrency", "comm", "memory",
+                 "determinism"),
         default="all",
         help="which pass(es) to run (default: %(default)s)",
     )
@@ -110,6 +111,12 @@ def main(argv: list[str] | None = None) -> int:
             findings, section = run_memory_pass()
             report.extend(findings)
             report.memory = section
+        if args.passes in ("all", "determinism"):
+            from .determinism import run_determinism_pass
+
+            findings, section = run_determinism_pass()
+            report.extend(findings)
+            report.determinism = section
 
     report.write_json(args.output)
     print(report.render())

@@ -1,0 +1,31 @@
+"""graftlint pass 13 — the determinism wall.
+
+Static divergence analysis over the bit-identity plane: an AST taint
+walk flagging divergence-feasible Python sources (``ast_walk``), an
+HLO leg asserting every compiled converge entry is replay-stable
+(``checker``), and the enumerated stale-tested waiver table
+(``waivers``).  The runtime half is ``tools/divergence_probe.py``.
+"""
+
+from .ast_walk import DET_AST_RULES, DET_TREES, run_det_ast_pass, scan_det_source
+from .checker import (
+    canonicalize_hlo,
+    check_recompile,
+    diff_canonical,
+    run_determinism_pass,
+    scan_module_text,
+)
+from .waivers import DET_WAIVERS
+
+__all__ = [
+    "DET_AST_RULES",
+    "DET_TREES",
+    "DET_WAIVERS",
+    "canonicalize_hlo",
+    "check_recompile",
+    "diff_canonical",
+    "run_determinism_pass",
+    "run_det_ast_pass",
+    "scan_det_source",
+    "scan_module_text",
+]

@@ -880,6 +880,122 @@ def _unbounded_cache_growth() -> tuple[str, str]:
     return _UNBOUNDED_CACHE_SRC, "protocol_tpu/node/_fixture_cache_growth.py"
 
 
+# -- pass-13 determinism fixtures -------------------------------------------
+
+_SET_ORDER_TO_STATE_SRC = '''\
+import numpy as np
+
+
+def stamp_columns(peers, scores):
+    # A peer *set* flattened straight into a checkpoint column: the
+    # array inherits per-process hash order, so two hosts digest
+    # different bytes from identical peer sets.
+    live = {p for p in peers if p >= 0}
+    column = np.asarray(list(live))  # VIOLATION: set-order-to-state
+    return column, scores[column]
+'''
+
+
+def _set_order_to_state() -> tuple[str, str]:
+    return _SET_ORDER_TO_STATE_SRC, "protocol_tpu/node/_fixture_set_order.py"
+
+
+_UNSORTED_DIRSCAN_SRC = '''\
+import os
+
+
+def replay_segments(wal_dir):
+    # WAL segments replayed in directory-scan order: inode history
+    # decides the replay sequence, so two hosts reconverge through
+    # different intermediate states.
+    names = os.listdir(wal_dir)  # VIOLATION: unsorted-dirscan
+    return [os.path.join(wal_dir, n) for n in names]
+'''
+
+
+def _unsorted_dirscan() -> tuple[str, str]:
+    return _UNSORTED_DIRSCAN_SRC, "protocol_tpu/node/_fixture_dirscan.py"
+
+
+_HASH_ORDERING_SRC = '''\
+def partition_key(sender_pk, n_partitions):
+    # Builtin hash() as a partition key: hash(str) is salted per
+    # process (PYTHONHASHSEED), so the same sender lands on different
+    # partitions on different hosts.
+    return hash(sender_pk) % n_partitions  # VIOLATION: hash-ordering
+'''
+
+
+def _hash_ordering() -> tuple[str, str]:
+    return _HASH_ORDERING_SRC, "protocol_tpu/ingest/_fixture_hash_key.py"
+
+
+_UNSEEDED_RNG_SRC = '''\
+import numpy as np
+
+
+def churn_draw(n_peers):
+    # A churn-stream draw from the process-global RNG: every host
+    # samples a different peer set, so the epoch graphs diverge
+    # before the first matvec.
+    return np.random.permutation(n_peers)  # VIOLATION: unseeded-rng
+'''
+
+
+def _unseeded_rng() -> tuple[str, str]:
+    return _UNSEEDED_RNG_SRC, "protocol_tpu/models/_fixture_churn_rng.py"
+
+
+_CLOCK_IN_DIGEST_SRC = '''\
+import hashlib
+import time
+
+
+def seal_manifest(columns):
+    # Wall clock folded into the manifest digest: the seal differs on
+    # every host and every replay, so bit-identity verification can
+    # never pass.
+    stamp = time.time()
+    h = hashlib.sha256(str(columns).encode())
+    h.update(str(stamp).encode())  # VIOLATION: clock-in-digest
+    return h.hexdigest()
+'''
+
+
+def _clock_in_digest() -> tuple[str, str]:
+    return _CLOCK_IN_DIGEST_SRC, "protocol_tpu/node/_fixture_clock_seal.py"
+
+
+def _hlo_nondeterministic_compile() -> tuple[str, str, str]:
+    # Two "compiles" of the same entry that differ structurally after
+    # canonicalization: identical SSA naming-counter noise (different
+    # value numbers, same shape) cancels out, but compile 2 fuses an
+    # extra multiply — the drift the double-compile cross-check exists
+    # to catch.
+    module_a = """\
+HloModule converge_fixture
+
+ENTRY %main.12 {
+  %param.0 = f32[64]{0} parameter(0)
+  %param.1 = f32[64]{0} parameter(1)
+  %add.3 = f32[64]{0} add(%param.0, %param.1)
+  ROOT %mul.4 = f32[64]{0} multiply(%add.3, %param.1)
+}
+"""
+    module_b = """\
+HloModule converge_fixture
+
+ENTRY %main.47 {
+  %param.8 = f32[64]{0} parameter(0)
+  %param.9 = f32[64]{0} parameter(1)
+  %mul.13 = f32[64]{0} multiply(%param.8, %param.9)
+  %add.11 = f32[64]{0} add(%mul.13, %param.9)
+  ROOT %mul.14 = f32[64]{0} multiply(%add.11, %param.9)
+}
+"""
+    return "fixture:hlo-drift", module_a, module_b
+
+
 FIXTURES: dict[str, Fixture] = {
     f.name: f
     for f in (
@@ -1017,6 +1133,30 @@ FIXTURES: dict[str, Fixture] = {
             _unbounded_cache_growth, "unbounded-cache-growth",
             kind="mem-ast",
         ),
+        Fixture(
+            "set-order-to-state", "set-order-to-state",
+            _set_order_to_state, "set-order-to-state", kind="det-ast",
+        ),
+        Fixture(
+            "unsorted-dirscan", "unsorted-dirscan",
+            _unsorted_dirscan, "unsorted-dirscan", kind="det-ast",
+        ),
+        Fixture(
+            "hash-ordering", "hash-ordering",
+            _hash_ordering, "hash-ordering", kind="det-ast",
+        ),
+        Fixture(
+            "unseeded-rng", "unseeded-rng",
+            _unseeded_rng, "unseeded-rng", kind="det-ast",
+        ),
+        Fixture(
+            "clock-in-digest", "clock-in-digest",
+            _clock_in_digest, "clock-in-digest", kind="det-ast",
+        ),
+        Fixture(
+            "hlo-nondeterministic-compile", "hlo-nondeterministic-compile",
+            _hlo_nondeterministic_compile, None, kind="det-hlo",
+        ),
     )
 }
 
@@ -1050,6 +1190,16 @@ def run_fixture(name: str) -> list[Finding]:
 
         source, rel_path = fixture.build()
         return scan_source(source, rel_path, mem_rules=True)
+    if fixture.kind == "det-ast":
+        from .determinism.ast_walk import scan_det_source
+
+        source, rel_path = fixture.build()
+        return scan_det_source(source, rel_path)
+    if fixture.kind == "det-hlo":
+        from .determinism.checker import check_recompile
+
+        backend, module_a, module_b = fixture.build()
+        return check_recompile(backend, module_a, module_b)
     budget, case = fixture.build()
     return check_case(budget, case)
 

@@ -77,6 +77,11 @@ class Report:
     #: allowances, host-transfer volumes, and the memory waiver list
     #: (analysis/memory/).
     memory: dict[str, Any] = field(default_factory=dict)
+    #: Pass-13 determinism section: per-backend HLO replay-stability
+    #: records (scatter/reduce-precision counts, double-compile drift),
+    #: AST files scanned, and the determinism waiver list
+    #: (analysis/determinism/).
+    determinism: dict[str, Any] = field(default_factory=dict)
 
     def extend(self, findings: list[Finding]) -> None:
         self.findings.extend(findings)
@@ -105,6 +110,7 @@ class Report:
             "concurrency": self.concurrency,
             "comm": self.comm,
             "memory": self.memory,
+            "determinism": self.determinism,
             "findings": [f.to_dict() for f in self.findings],
         }
 

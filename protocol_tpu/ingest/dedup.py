@@ -23,6 +23,26 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+#: splitmix64 odd multiplier (golden-ratio constant).
+_MIX = 0x9E3779B97F4A7C15
+_MASK = (1 << 64) - 1
+
+
+def _shard_index(sender: tuple[int, int], n_shards: int) -> int:
+    """Stable shard key: a splitmix-style mix of the sender's pk
+    coordinates, identical across processes and interpreter versions by
+    construction.  Builtin ``hash()`` happens to be salt-free for int
+    tuples in today's CPython, but shard placement is observable state
+    (lock contention patterns, eviction order under ``senders_per_shard``
+    pressure), and the bit-identity plane does not stand on
+    implementation details — pass-13 doctrine."""
+    x, y = sender
+    acc = (int(x) * _MIX + int(y)) & _MASK
+    acc ^= acc >> 31
+    acc = (acc * 0xBF58476D1CE4E5B9) & _MASK
+    acc ^= acc >> 27
+    return acc % n_shards
+
 
 @dataclass
 class _Shard:
@@ -61,7 +81,7 @@ class ShardedDedupCache:
         self.senders_per_shard = int(senders_per_shard)
 
     def _shard(self, sender: tuple[int, int]) -> _Shard:
-        return self._shards[hash(sender) % len(self._shards)]
+        return self._shards[_shard_index(sender, len(self._shards))]
 
     def admit(
         self, sender: tuple[int, int], digest: bytes, nonce: int | None = None

@@ -276,12 +276,15 @@ class CheckpointStore:
 
     def epochs(self) -> list[int]:
         # Sidecar files (epoch_N.plan.npz) share the prefix and glob;
-        # only bare epoch_N.npz snapshots define the epoch set.
-        return [
+        # only bare epoch_N.npz snapshots define the epoch set.  The
+        # scan is sorted numerically: glob order is inode-history-
+        # dependent, and this list feeds prune order and the boot-time
+        # latest() pick, which must match across hosts bit for bit.
+        return sorted(
             int(p.stem.removeprefix("epoch_"))
             for p in self.dir.glob("epoch_*.npz")
             if p.stem.removeprefix("epoch_").isdigit()
-        ]
+        )
 
     def manifest_entry(self, epoch: Epoch) -> dict | None:
         """This epoch's manifest entry (column/plan digests + WAL

@@ -41,8 +41,8 @@ exchange, never O(E).
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -155,6 +155,7 @@ class PodWindowPlan:
         plan: WindowPlan | None = None,
         delta_rows: np.ndarray | None = None,
         interpret: bool | None = None,
+        clock: Callable[[], float] | None = None,
     ) -> "PodWindowPlan":
         """Partition the graph by source-peer owner, resolve this
         host's local plan (reuse / delta / rebuild against the local
@@ -162,7 +163,11 @@ class PodWindowPlan:
         cut it across the local devices, and assemble the global
         sharded arrays.  ``plan`` is this host's cached *local* plan
         (checkpoint-shard restored); ``delta_rows`` is the global
-        churn hint, clipped to owned rows here."""
+        churn hint, clipped to owned rows here.  ``clock`` is the
+        caller's monotonic clock for the ``build_seconds`` field —
+        instrumentation wraps kernel trees from the outside (graftlint
+        clock-in-kernel-tree doctrine), so without one the field
+        stays 0.0."""
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         owner = pod.partition.assign_ids(g.n)
@@ -174,7 +179,7 @@ class PodWindowPlan:
         build_seconds = 0.0
         valid = plan is not None and getattr(plan, "version", 0) == PLAN_VERSION
         if not (valid and plan.fingerprint == fp):
-            t_build = time.perf_counter()
+            t_build = clock() if clock is not None else 0.0
             delta = None
             if valid and owned_rows is not None and owned_rows.size:
                 delta = try_plan_delta(
@@ -185,7 +190,8 @@ class PodWindowPlan:
             else:
                 plan = build_window_plan(lsrc, ldst, lw, n=g.n)
                 outcome = "rebuild"
-            build_seconds = time.perf_counter() - t_build
+            if clock is not None:
+                build_seconds = clock() - t_build
 
         # Pod-wide dimension agreement: every global shard must carry
         # the same (rows_per_shard, s_max) so the compiled runner sees

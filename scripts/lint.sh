@@ -27,13 +27,20 @@ python -m compileall -q protocol_tpu tests tools bench bench.py __graft_entry__.
 # E/n_shards, an N-linear transient allowance in which an O(E) live
 # temporary is inexpressible, donation-reduces-peak, host-staging
 # caps, plus the edge-materialization and cache-growth AST rules over
-# node/ and ingest/).  Any error-severity
-# finding — including an unwaived concurrency/comm/memory finding or a
-# STALE waiver in any table — fails here.  Emits ANALYSIS.json
-# (uploaded as a CI artifact; the concurrency, comm, and memory
-# sections carry the root inventory, guard map, lock graph,
-# per-backend collective/byte and resident/transient tables, and
-# waiver lists).
+# node/ and ingest/); pass 13 is the determinism analyzer (the AST
+# divergence walk over node/, parallel/, ingest/, prover/ and models/
+# — set-order-to-state, unsorted-dirscan, hash-ordering, unseeded-rng,
+# clock-in-digest — plus the HLO leg riding the pass-8/12 lowerings:
+# no scatter without unique_indices, no reduce-precision on the f32
+# path, and every backend compiled twice with a canonicalized
+# module-text diff so compile-time entropy itself is gated).  Any
+# error-severity finding — including an unwaived
+# concurrency/comm/memory/determinism finding or a STALE waiver in any
+# table — fails here.  Emits ANALYSIS.json (uploaded as a CI artifact;
+# the concurrency, comm, memory, and determinism sections carry the
+# root inventory, guard map, lock graph, per-backend collective/byte,
+# resident/transient and scatter/recompile-drift tables, and waiver
+# lists).
 python -m protocol_tpu.analysis --output ANALYSIS.json
 
 # Trees held to the hard format/type gates: the convergence-kernel,

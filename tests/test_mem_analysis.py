@@ -308,15 +308,16 @@ class TestStaleWaivers:
         assert [f.rule for f in findings] == ["stale-waiver"]
         assert findings[0].severity == "error"
 
-    def test_all_three_tables_enforce_staleness(self):
-        """Concurrency, comm, and memory waiver tables all turn a dead
-        entry into an error — no table rots silently."""
+    def test_all_four_tables_enforce_staleness(self):
+        """Concurrency, comm, memory, and determinism waiver tables all
+        turn a dead entry into an error — no table rots silently."""
         from protocol_tpu.analysis.comm import checker as comm_checker
         from protocol_tpu.analysis.concurrency.checker import (
             analyze_models,
             build_program_model,
         )
         from protocol_tpu.analysis.concurrency.waivers import Waiver
+        from protocol_tpu.analysis.determinism import checker as det_checker
         from protocol_tpu.analysis.memory import checker as mem_checker
 
         dead = Waiver(rule="x", file="gone.py", symbol="ghost", reason="r")
@@ -325,7 +326,7 @@ class TestStaleWaivers:
             (dead,),
         )
         assert [f.rule for f in conc] == ["stale-waiver"]
-        for checker in (comm_checker, mem_checker):
+        for checker in (comm_checker, mem_checker, det_checker):
             live, _, stale = checker._apply_waivers([])
             # the committed tables have no dead entries...
             assert [s for s in stale if s["symbol"] == "ghost"] == []

@@ -25,6 +25,7 @@ can be checked in one process:
 import json
 import sys
 import threading
+import time
 import types
 from pathlib import Path
 
@@ -71,7 +72,7 @@ class TestPodPlanBuild:
     def test_single_host_pod_matches_sharded_plan(self):
         g = _graph()
         pod = _pod1()
-        pp = PodWindowPlan.build(g, pod)
+        pp = PodWindowPlan.build(g, pod, clock=time.perf_counter)
         sp = ShardedWindowPlan.build(g, default_mesh())
         assert (pp.n, pp.rows_per_shard, pp.s_max, pp.table_entries) == (
             sp.n, sp.rows_per_shard, sp.s_max, sp.table_entries

@@ -1,0 +1,504 @@
+"""Differential replay probe: pass 13's runtime half (the
+``comm_probe``/``mem_probe`` analog for the determinism wall).
+
+The static legs prove no divergence-feasible *source* reaches a
+bit-identity sink; this probe proves the whole replay is a pure
+function of the protocol seed by running the 2-process gloo churned
+pod replay (``tools/dryrun_pod.py`` workers) **twice under perturbed
+schedules** and requiring every sink identical across runs:
+
+- **perturbations** (everything a correct run must be invariant to):
+  different ``PYTHONHASHSEED`` (set/dict-salt divergence), different
+  ``OMP_NUM_THREADS`` (host-side BLAS/XLA thread partitioning),
+  reversed host launch order with a stagger (coordinator rendezvous
+  timing), and different fleet-directory scrape interleavings (decoy
+  snapshot files created in a different order + a concurrent scraper
+  thread merging the directory at a different cadence during the
+  replay);
+- **asserted identical across runs**: per-host WAL ack digests
+  (``acks-h*.jsonl``), checkpoint column sha256s
+  (``checkpoints/manifest.json``), every sealed pod manifest and shard
+  stamp (full canonical JSON), per-epoch residuals + score digests,
+  the final score fixed point (digest AND dumped ``.npy`` bytes), and
+  the commitment proof bytes derived from the final scores through the
+  real prover path (``zk.proof.PoseidonCommitmentProver``);
+- **asserted within each run**: per-epoch cross-host score/residual
+  agreement (the pod either agrees bit-for-bit or is broken), and the
+  fleet-directory merge reaching the same aggregate regardless of
+  scan interleaving.
+
+``--seed-divergence`` is the CI self-check: it perturbs the one knob a
+replay is *allowed* to depend on (the protocol seed) in the second
+schedule, so every digest leg must trip and the probe must exit 1 —
+proving the comparator actually compares.
+
+Run::
+
+    python tools/divergence_probe.py --smoke --out DET_smoke.json
+    python tools/divergence_probe.py --peers 4096 --edges 32768 \
+        --epochs 3 --round 1 --out DET_r01.json
+
+Exit 0 = every sink bit-identical across both schedules (or the jax
+build has no multi-process CPU collectives: ``skipped``); 1 =
+divergence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DRYRUN = REPO / "tools" / "dryrun_pod.py"
+
+#: The two perturbed schedules.  Everything here is a knob a correct
+#: replay must be INVARIANT to; the protocol seed (the one legitimate
+#: input) is held fixed across both.
+SCHEDULES: tuple[dict, ...] = (
+    {
+        "name": "baseline",
+        "hashseed": "1",
+        "omp_threads": "2",
+        "reverse_launch": False,
+        "launch_stagger": 0.0,
+        "decoy_order": (0, 1, 2),
+        "scrape_interval": 0.05,
+    },
+    {
+        "name": "perturbed",
+        "hashseed": "31337",
+        "omp_threads": "1",
+        "reverse_launch": True,
+        "launch_stagger": 0.25,
+        "decoy_order": (2, 0, 1),
+        "scrape_interval": 0.013,
+    },
+)
+
+
+def _sha256_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _canonical_json_digest(obj) -> str:
+    return _sha256_bytes(
+        json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+    )
+
+
+# ---------------------------------------------------------------------------
+# fleet-directory scrape interleaving
+# ---------------------------------------------------------------------------
+
+#: Fixed-content decoy snapshots (obs.fleet snapshot schema): only
+#: their creation ORDER and the scrape cadence differ per schedule, so
+#: any aggregate difference is a scan-order dependence in the merge.
+_DECOYS = tuple(
+    {
+        "version": 1,
+        "pid": 900000 + i,
+        "source": f"decoy-{i}",
+        "taken_unix": 0,
+        "metrics": {
+            "probe_decoy_total": {
+                "kind": "counter",
+                "help": "divergence-probe decoy series",
+                "labelnames": ["decoy"],
+                "samples": [[[str(i)], float(10 * (i + 1))]],
+            }
+        },
+    }
+    for i in range(3)
+)
+
+
+def _write_decoys(fleet_dir: Path, order: tuple[int, ...]) -> None:
+    fleet_dir.mkdir(parents=True, exist_ok=True)
+    for i in order:
+        path = fleet_dir / f"fleet-decoy{i}.json"
+        path.write_text(json.dumps(_DECOYS[i]) + "\n")
+        time.sleep(0.01)  # distinct mtimes: a real creation-order skew
+
+
+class _Scraper(threading.Thread):
+    """Concurrent fleet-directory merge during the replay — the scrape
+    interleaving leg.  Owns a private aggregator so two schedules'
+    merges never share state."""
+
+    def __init__(self, fleet_dir: Path, interval: float):
+        super().__init__(daemon=True)
+        from protocol_tpu.obs.fleet import FleetAggregator
+
+        self.fleet_dir = fleet_dir
+        self.interval = interval
+        self.aggregator = FleetAggregator()
+        self.scrapes = 0
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        from protocol_tpu.obs.fleet import load_directory
+
+        while not self._halt.is_set():
+            load_directory(self.fleet_dir, self.aggregator)
+            self.scrapes += 1
+            self._halt.wait(self.interval)
+
+    def finish(self) -> dict:
+        self._halt.set()
+        self.join(timeout=10.0)
+        return {
+            "scrapes": self.scrapes,
+            "sources": self.aggregator.sources(),
+            "aggregate_sha256": _canonical_json_digest(
+                self.aggregator.snapshots()
+            ),
+        }
+
+
+# ---------------------------------------------------------------------------
+# one schedule = one full pod replay
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_cmd(args, i: int, coordinator: str, state: Path, out: Path,
+                scores: Path | None, seed: int) -> list[str]:
+    cmd = [
+        sys.executable, str(DRYRUN),
+        "--worker", str(i),
+        "--coordinator", coordinator,
+        "--state-dir", str(state),
+        "--worker-out", str(out),
+        "--peers", str(args.peers), "--edges", str(args.edges),
+        "--epochs", str(args.epochs), "--churn", str(args.churn),
+        "--processes", str(args.processes),
+        "--local-devices", str(args.local_devices),
+        "--seed", str(seed), "--tol", str(args.tol),
+        "--max-iter", str(args.max_iter),
+        "--seal-timeout", str(args.seal_timeout),
+        "--skip-scrape",
+    ]
+    if scores is not None and i == 0:
+        cmd += ["--dump-scores", str(scores)]
+    return cmd
+
+
+def run_schedule(args, sched: dict, workdir: Path, *, seed: int) -> dict:
+    """One perturbed full replay; returns the run record with every
+    sink digested."""
+    state = workdir / f"state-{sched['name']}"
+    out_dir = workdir / f"out-{sched['name']}"
+    fleet_dir = workdir / f"fleet-{sched['name']}"
+    state.mkdir(parents=True, exist_ok=True)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    scores_path = workdir / f"scores-{sched['name']}.npy"
+    _write_decoys(fleet_dir, sched["decoy_order"])
+
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = sched["hashseed"]
+    env["OMP_NUM_THREADS"] = sched["omp_threads"]
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    outs = [out_dir / f"worker{i}.json" for i in range(args.processes)]
+    order = list(range(args.processes))
+    if sched["reverse_launch"]:
+        order.reverse()
+
+    scraper = _Scraper(fleet_dir, sched["scrape_interval"])
+    scraper.start()
+    t0 = time.perf_counter()
+    procs: dict[int, subprocess.Popen] = {}
+    for i in order:
+        procs[i] = subprocess.Popen(
+            _worker_cmd(args, i, coordinator, state, outs[i], scores_path, seed),
+            cwd=REPO, env=env,
+        )
+        if sched["launch_stagger"]:
+            time.sleep(sched["launch_stagger"])
+
+    rcs: list[int | None] = [None] * args.processes
+    deadline = time.monotonic() + args.timeout
+    while any(rc is None for rc in rcs):
+        for i, p in procs.items():
+            if rcs[i] is None:
+                rcs[i] = p.poll()
+        if time.monotonic() > deadline:
+            for i, p in procs.items():
+                if rcs[i] is None:
+                    p.kill()
+                    rcs[i] = -9
+            break
+        time.sleep(0.2)
+    for p in procs.values():
+        p.wait()
+    wall = time.perf_counter() - t0
+    fleet = scraper.finish()
+
+    workers = []
+    for path in outs:
+        try:
+            workers.append(json.loads(path.read_text()))
+        except (OSError, json.JSONDecodeError):
+            workers.append({"ok": False, "error": "no worker report"})
+
+    # -- digest every sink ------------------------------------------------
+    acks = {
+        p.name: _sha256_bytes(p.read_bytes())
+        for p in sorted(state.rglob("acks-h*.jsonl"))
+    }
+    # Checkpoint manifests + pod manifests + shard stamps: canonical
+    # JSON digests keyed by state-relative path.  All of these are
+    # deterministic JSON by contract — any wall-clock or pid that leaks
+    # in shows up here as a cross-run mismatch.
+    manifests = {}
+    for p in sorted(state.rglob("*.json")):
+        try:
+            manifests[str(p.relative_to(state))] = _canonical_json_digest(
+                json.loads(p.read_text())
+            )
+        except (OSError, json.JSONDecodeError):
+            manifests[str(p.relative_to(state))] = "unreadable"
+    epoch_digests = [
+        {
+            "epoch": ep["epoch"],
+            "residual": ep["residual"],
+            "scores_sha256": ep["scores_sha256"],
+        }
+        for ep in (workers[0].get("epochs") or [])
+    ]
+    # Cross-host agreement within this run (every host holds the
+    # replicated vector — exact equality, not a tolerance).
+    by_epoch: dict[int, set] = {}
+    for w in workers:
+        for ep in w.get("epochs") or []:
+            by_epoch.setdefault(ep["epoch"], set()).add(
+                (ep["residual"], ep["scores_sha256"])
+            )
+    cross_host_ok = bool(by_epoch) and all(
+        len(v) == 1 for v in by_epoch.values()
+    )
+
+    scores_sha = None
+    proof = None
+    if scores_path.exists():
+        scores_sha = _sha256_bytes(scores_path.read_bytes())
+        proof = _proof_digest(scores_path)
+
+    return {
+        "schedule": {k: v for k, v in sched.items()},
+        "seed": seed,
+        "return_codes": rcs,
+        "workers_ok": [bool(w.get("ok")) for w in workers],
+        "skipped": all(w.get("skipped") for w in workers),
+        "wall_seconds": round(wall, 4),
+        "wal_ack_digests": acks,
+        "manifest_digests": manifests,
+        "epoch_digests": epoch_digests,
+        "cross_host_bit_identity": cross_host_ok,
+        "final_scores_sha256": [
+            w.get("final_scores_sha256") for w in workers
+        ],
+        "scores_npy_sha256": scores_sha,
+        "proof": proof,
+        "fleet": fleet,
+    }
+
+
+def _proof_digest(scores_path: Path) -> dict:
+    """Commitment proof bytes over the final fixed point, through the
+    real prover path: quantized scores as public inputs, the leading
+    rows as witness ops.  A pure function of the replay output — two
+    bit-identical replays must produce byte-identical proofs."""
+    import numpy as np
+
+    from protocol_tpu.zk.proof import PoseidonCommitmentProver
+
+    scores = np.load(scores_path)
+    scale = 1 << 24
+    pub_ins = [int(round(float(x) * scale)) for x in scores[:64]]
+    ops = [pub_ins[:16], pub_ins[16:32]]
+    prover = PoseidonCommitmentProver()
+    proof = prover.prove(pub_ins, {"ops": ops})
+    return {
+        "prover": prover.name,
+        "proof_bytes": len(proof),
+        "proof_sha256": _sha256_bytes(proof),
+        "verified": bool(prover.verify(pub_ins, proof)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# cross-run comparison
+# ---------------------------------------------------------------------------
+
+
+def compare_runs(a: dict, b: dict) -> dict:
+    """Leg-by-leg equality verdicts across two perturbed replays.
+    Every leg must hold; the record names each one so DET_r*.json reads
+    as the sentinel-shaped evidence table."""
+    legs = {
+        "return_codes": a["return_codes"] == b["return_codes"] == [0] * len(
+            a["return_codes"]
+        ),
+        "workers_ok": all(a["workers_ok"]) and all(b["workers_ok"]),
+        "wal_ack_digests": (
+            a["wal_ack_digests"] == b["wal_ack_digests"]
+            and bool(a["wal_ack_digests"])
+        ),
+        "manifest_digests": (
+            a["manifest_digests"] == b["manifest_digests"]
+            and bool(a["manifest_digests"])
+            and "unreadable" not in a["manifest_digests"].values()
+        ),
+        "epoch_digests": (
+            a["epoch_digests"] == b["epoch_digests"]
+            and bool(a["epoch_digests"])
+        ),
+        "cross_host_bit_identity": (
+            a["cross_host_bit_identity"] and b["cross_host_bit_identity"]
+        ),
+        "final_scores_sha256": (
+            a["final_scores_sha256"] == b["final_scores_sha256"]
+            and len(set(a["final_scores_sha256"])) == 1
+            and a["final_scores_sha256"][0] is not None
+        ),
+        "scores_npy_bytes": (
+            a["scores_npy_sha256"] == b["scores_npy_sha256"]
+            and a["scores_npy_sha256"] is not None
+        ),
+        "proof_bytes": (
+            a["proof"] is not None
+            and b["proof"] is not None
+            and a["proof"]["proof_sha256"] == b["proof"]["proof_sha256"]
+            and a["proof"]["verified"]
+            and b["proof"]["verified"]
+        ),
+        "fleet_merge_order_insensitive": (
+            a["fleet"]["aggregate_sha256"] == b["fleet"]["aggregate_sha256"]
+            and a["fleet"]["sources"] == b["fleet"]["sources"]
+            and bool(a["fleet"]["sources"])
+        ),
+    }
+    return {"ok": all(legs.values()), "legs": legs}
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="DET_smoke.json")
+    ap.add_argument("--peers", type=int, default=4096)
+    ap.add_argument("--edges", type=int, default=32768)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--churn", type=float, default=0.01)
+    ap.add_argument("--processes", type=int, default=2)
+    ap.add_argument("--local-devices", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=16)
+    ap.add_argument("--tol", type=float, default=1e-6)
+    ap.add_argument("--max-iter", type=int, default=60)
+    ap.add_argument("--seal-timeout", type=float, default=120.0)
+    ap.add_argument("--timeout", type=float, default=1800.0)
+    ap.add_argument("--smoke", action="store_true", help="CI scale")
+    ap.add_argument(
+        "--seed-divergence", action="store_true",
+        help="self-check: perturb the protocol seed in schedule 2 so "
+        "every digest leg must trip and the probe must exit 1",
+    )
+    ap.add_argument("--round", type=int, default=0)
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.peers = min(args.peers, 2048)
+        args.edges = min(args.edges, 16384)
+        args.epochs = min(args.epochs, 3)
+
+    tmp_ctx = tempfile.TemporaryDirectory() if args.workdir is None else None
+    workdir = Path(args.workdir or tmp_ctx.name)
+    workdir.mkdir(parents=True, exist_ok=True)
+    try:
+        runs = []
+        for idx, sched in enumerate(SCHEDULES):
+            seed = args.seed + (
+                1 if (args.seed_divergence and idx == 1) else 0
+            )
+            runs.append(run_schedule(args, sched, workdir, seed=seed))
+    finally:
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
+
+    skipped = all(r["skipped"] for r in runs)
+    comparison = None if skipped else compare_runs(runs[0], runs[1])
+    ok = skipped or comparison["ok"]
+
+    scale = f"{args.peers} peers/{args.edges} edges"
+    mesh = f"{args.processes}x{args.local_devices}"
+    entries: list[dict] = []
+    if not skipped:
+        entries.append({
+            "metric": (
+                f"divergence probe full-replay wall-clock ({scale}, "
+                f"{mesh} mesh, {args.epochs} epochs, per perturbed "
+                "schedule)"
+            ),
+            "value": round(
+                sum(r["wall_seconds"] for r in runs) / len(runs), 4
+            ),
+            "unit": "seconds",
+            "n_hosts": args.processes,
+            "per_schedule_seconds": [r["wall_seconds"] for r in runs],
+            "legs_checked": (
+                sorted(comparison["legs"]) if comparison else []
+            ),
+        })
+
+    report = {
+        "tool": "divergence_probe",
+        "round": args.round,
+        "mesh": mesh,
+        "n_hosts": args.processes,
+        "n_cpus": os.cpu_count(),
+        "params": {
+            "peers": args.peers, "edges": args.edges,
+            "epochs": args.epochs, "churn": args.churn,
+            "tol": args.tol, "max_iter": args.max_iter,
+            "seed": args.seed,
+        },
+        "seed_divergence_mode": bool(args.seed_divergence),
+        "ok": bool(ok),
+        "skipped": skipped,
+        "comparison": comparison,
+        "entries": entries,
+        "runs": runs,
+    }
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    status = (
+        "SKIPPED (no multi-process CPU collectives)" if skipped
+        else ("OK" if ok else "FAILED")
+    )
+    print(f"divergence_probe: {status} — report in {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -404,7 +404,9 @@ def worker_main(args) -> int:
                 os._exit(137)
 
         t_plan = time.perf_counter()
-        podplan = PodWindowPlan.build(cur, pod, plan=plan, delta_rows=rows)
+        podplan = PodWindowPlan.build(
+            cur, pod, plan=plan, delta_rows=rows, clock=time.perf_counter
+        )
         plan_update_seconds = time.perf_counter() - t_plan
         plan = podplan.plan
 
@@ -555,7 +557,7 @@ def reference_main(args) -> int:
     for e in range(1, args.epochs):
         _, cur, _ = churn_epoch(cur, e, args)
     pod = PodContext.current(seed=args.seed)  # single process
-    podplan = PodWindowPlan.build(cur, pod)
+    podplan = PodWindowPlan.build(cur, pod, clock=time.perf_counter)
     t, iters, resid = converge_sharded(
         podplan, alpha=0.1, tol=args.tol, max_iter=args.max_iter
     )

@@ -7,8 +7,9 @@ The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
 ``PROVER_r*.json``, since ISSUE 11 the fleet-observability rounds
 ``OBS_r*.json``, since ISSUE 14 the crash-matrix rounds
 ``CHAOS_r*.json``, since ISSUE 15 the memory-probe rounds
-``MEM_r*.json``, and since ISSUE 16 the pod scale-out rounds
-``POD_r*.json``) but nothing ever *read* the series — a PR could
+``MEM_r*.json``, since ISSUE 16 the pod scale-out rounds
+``POD_r*.json``, and since ISSUE 18 the divergence-probe rounds
+``DET_r*.json``) but nothing ever *read* the series — a PR could
 halve headline throughput and no gate would notice.  This tool closes
 the loop: it parses the recorded rounds into per-metric series
 (headline convergence seconds, cold/steady-state epoch seconds, plan
@@ -314,6 +315,7 @@ def main(argv: list[str] | None = None) -> int:
         "CHAOS_r*.json",
         "MEM_r*.json",
         "POD_r*.json",
+        "DET_r*.json",
     ]
     paths = [
         Path(p) for pat in patterns for p in globlib.glob(str(root / pat))
