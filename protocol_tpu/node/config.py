@@ -142,6 +142,20 @@ class ProtocolConfig:
     #: GET /slo to ok=false and fails the CI dryrun.
     slo_freshness_p99_s: float = 120.0
     slo_proof_lag_p99_s: float = 60.0
+    #: Fleet snapshot staleness TTL (obs/fleet.py): a sibling whose
+    #: newest fleet_dir snapshot is older than this is evicted from
+    #: the merged scrape, counted on eigentrust_fleet_stale_sources,
+    #: and degrades /healthz — a silently dead pod host surfaces here
+    #: before a gloo collective hangs on it.  0 disables the TTL.
+    fleet_stale_after_s: float = 30.0
+    #: Pod straggler watcher (obs/watchers.py StragglerWatcher): flag a
+    #: host whose phase time exceeds the pod median by this ratio for
+    #: this many consecutive stitched epochs.
+    straggler_ratio: float = 1.5
+    straggler_epochs: int = 3
+    #: Pod phase-skew SLO target (obs/slo.py pod_objectives): p99 of
+    #: max-median host duration per epoch phase, seconds.
+    slo_pod_skew_p99_s: float = 1.0
 
     @property
     def host(self) -> str:
@@ -211,6 +225,18 @@ class ProtocolConfig:
         )
         cfg.slo_proof_lag_p99_s = float(
             obj.get("slo_proof_lag_p99_s", cfg.slo_proof_lag_p99_s)
+        )
+        cfg.fleet_stale_after_s = float(
+            obj.get("fleet_stale_after_s", cfg.fleet_stale_after_s)
+        )
+        cfg.straggler_ratio = float(
+            obj.get("straggler_ratio", cfg.straggler_ratio)
+        )
+        cfg.straggler_epochs = int(
+            obj.get("straggler_epochs", cfg.straggler_epochs)
+        )
+        cfg.slo_pod_skew_p99_s = float(
+            obj.get("slo_pod_skew_p99_s", cfg.slo_pod_skew_p99_s)
         )
         return cfg
 

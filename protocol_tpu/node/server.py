@@ -158,6 +158,33 @@ def node_health(node: "Node | None") -> tuple[int, dict]:
             "configured": bool(node.config.epoch_pipeline),
             "queue_depth": obs_metrics.PIPELINE_QUEUE_DEPTH.value(),
         }
+        if node.config.fleet_dir:
+            # Pod heartbeat check (ISSUE 19): stamp our own snapshot
+            # (the heartbeat other hosts' TTL reads) and re-scan the
+            # exchange with the staleness TTL, so a silently dead
+            # sibling degrades THIS host's /healthz before any gloo
+            # collective hangs waiting for it.
+            import os as _os
+
+            from ..obs.fleet import FLEET, load_directory, publish_snapshot
+
+            try:
+                publish_snapshot(node.config.fleet_dir, _os.getpid())
+                load_directory(
+                    node.config.fleet_dir,
+                    skip_pid=_os.getpid(),
+                    max_age_s=node.config.fleet_stale_after_s or None,
+                )
+            except OSError:
+                pass
+            stale = FLEET.stale()
+            components["fleet"] = {
+                "configured": True,
+                "sources": FLEET.sources(),
+                "stale": {s: round(a, 3) for s, a in sorted(stale.items())},
+            }
+            if stale:
+                degraded.append("fleet-stale-sources")
 
     if problems:
         verdict = "failed"
@@ -279,7 +306,11 @@ def handle_request(
             from ..obs.fleet import load_directory, publish_snapshot
 
             publish_snapshot(node.config.fleet_dir, _os.getpid())
-            load_directory(node.config.fleet_dir, skip_pid=_os.getpid())
+            load_directory(
+                node.config.fleet_dir,
+                skip_pid=_os.getpid(),
+                max_age_s=node.config.fleet_stale_after_s or None,
+            )
         return 200, fleet_prometheus_text()
     if method == "GET" and path == "/slo":
         # Evaluate-on-scrape: the engine also evaluates at every epoch
@@ -331,6 +362,47 @@ def handle_request(
             return BAD_REQUEST, "InvalidQuery"
         events = JOURNAL.tail(None if n < 0 else n)
         return 200, "".join(json.dumps(e) + "\n" for e in events)
+    if method == "GET" and path.startswith("/trace/pod"):
+        # /trace/pod/<epoch> (or /trace/pod[/latest]): the stitched
+        # pod epoch trace — N hosts' span trees clock-aligned onto one
+        # timeline with per-phase skew, barrier-arrival spread, and
+        # phase attribution (obs/podtrace.py).  Serves the stitch
+        # store; a miss with a configured fleet_dir stitches on demand
+        # from the published per-host files (any host can answer, not
+        # just the host that stitched at tick time).
+        from ..obs import podtrace
+
+        arg = path.removeprefix("/trace/pod").lstrip("/")
+        fleet_dir = (
+            node.config.fleet_dir
+            if node is not None and node.config.fleet_dir
+            else None
+        )
+        if arg in ("", "latest"):
+            # "latest" is the newer of the local stitch store and the
+            # published exchange — a host whose store lags (it is not
+            # the tick-time stitcher) must not serve a stale epoch.
+            latest = podtrace.POD_TRACES.latest_epoch()
+            if fleet_dir is not None:
+                published = podtrace.directory_epochs(fleet_dir)
+                if published and (latest is None or published[-1] > latest):
+                    latest = published[-1]
+            if latest is None:
+                return NOT_FOUND, json.dumps({"error": "no pod epochs stitched yet"})
+            arg = str(latest)
+        try:
+            epoch_number = int(arg)
+        except ValueError:
+            return BAD_REQUEST, "InvalidQuery"
+        stitched = podtrace.POD_TRACES.get(epoch_number)
+        if stitched is None and fleet_dir is not None:
+            stitched = podtrace.stitch_epoch(fleet_dir, epoch_number)
+        if stitched is None:
+            return NOT_FOUND, json.dumps(
+                {"error": f"no pod trace for epoch {epoch_number}",
+                 "stitched_epochs": podtrace.POD_TRACES.epochs()}
+            )
+        return 200, json.dumps(stitched)
     if method == "GET" and path.startswith("/trace/"):
         # /trace/<epoch> (or /trace/latest): the epoch's span tree as
         # nested JSON (epoch_tick → prove/build_graph/plan/converge/
@@ -894,6 +966,21 @@ class Node:
             freshness_p99_s=self.config.slo_freshness_p99_s,
             proof_lag_p99_s=self.config.slo_proof_lag_p99_s,
         )
+        # Pod objectives only where a pod exchange exists: a
+        # single-process node must not carry objectives over signals
+        # it can never produce (they would read None forever).
+        if self.config.fleet_dir:
+            from ..obs.slo import install_pod_defaults
+            from ..obs.watchers import STRAGGLERS
+
+            install_pod_defaults(
+                phase_skew_p99_s=self.config.slo_pod_skew_p99_s,
+                heartbeat_max_age_s=self.config.fleet_stale_after_s,
+            )
+            STRAGGLERS.configure(
+                ratio=self.config.straggler_ratio,
+                k=self.config.straggler_epochs,
+            )
         # SIGTERM post-mortem: dump the event ring before the process
         # dies, so "what was the node doing" survives an orchestrator
         # kill.  Best-effort — platforms without add_signal_handler

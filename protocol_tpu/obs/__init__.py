@@ -66,7 +66,19 @@ from .fleet import FLEET, FleetAggregator, fleet_prometheus_text, registry_snaps
 from .journal import JOURNAL, FlightRecorder
 from .lineage import LINEAGE, LineageTracker
 from .metrics import METRICS, MetricsRegistry
-from .slo import SLO_ENGINE, SLOEngine, SLObjective
+from .podtrace import (
+    POD_TRACES,
+    PodTraceStore,
+    publish_epoch_trace,
+    stitch_epoch,
+)
+from .slo import (
+    SLO_ENGINE,
+    SLOEngine,
+    SLObjective,
+    install_pod_defaults,
+    pod_objectives,
+)
 from .timeline import TIMELINE, TimelineRegistry
 from .trace import (
     TRACER,
@@ -79,9 +91,11 @@ from .watchers import (
     DRIFT,
     MEMORY_WATERMARKS,
     RECOMPILES,
+    STRAGGLERS,
     MemoryWatermarkWatcher,
     RecompileTracker,
     ScoreDriftMonitor,
+    StragglerWatcher,
 )
 
 
@@ -130,27 +144,35 @@ __all__ = [
     "LINEAGE",
     "METRICS",
     "MEMORY_WATERMARKS",
+    "POD_TRACES",
     "RECOMPILES",
     "SLO_ENGINE",
+    "STRAGGLERS",
     "TIMELINE",
     "FleetAggregator",
     "FlightRecorder",
     "LineageTracker",
     "MemoryWatermarkWatcher",
     "MetricsRegistry",
+    "PodTraceStore",
     "RecompileTracker",
     "SLOEngine",
     "SLObjective",
     "ScoreDriftMonitor",
     "Span",
     "SpanContextFilter",
+    "StragglerWatcher",
     "TRACER",
     "TimelineRegistry",
     "Tracer",
     "configure_logging",
     "fleet_prometheus_text",
+    "install_pod_defaults",
     "metrics_json",
+    "pod_objectives",
     "profile_session",
     "prometheus_text",
+    "publish_epoch_trace",
     "registry_snapshot",
+    "stitch_epoch",
 ]

@@ -93,11 +93,19 @@ def registry_snapshot(
 
 
 class FleetAggregator:
-    """Latest-snapshot-per-source store behind the fleet scrape."""
+    """Latest-snapshot-per-source store behind the fleet scrape.
+
+    Sources also carry a *staleness* side-table: a source whose newest
+    snapshot aged past the directory TTL (:func:`load_directory`'s
+    ``max_age_s``) is evicted from the merged exposition but remembered
+    here with its age, so ``/healthz`` can degrade on a silently dead
+    pod host instead of trusting its last numbers forever.  A fresh
+    ingest clears the mark."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._sources: dict[str, dict[str, Any]] = {}
+        self._stale: dict[str, float] = {}
 
     def ingest(self, source: str, snapshot: dict[str, Any]) -> None:
         """Install (or replace) one source's snapshot.  Cumulative
@@ -107,14 +115,33 @@ class FleetAggregator:
             return
         with self._lock:
             self._sources[str(source)] = snapshot
-            n = len(self._sources)
+            self._stale.pop(str(source), None)
+            n, n_stale = len(self._sources), len(self._stale)
         _metrics.FLEET_SOURCES.set(n)
+        _metrics.FLEET_STALE_SOURCES.set(n_stale)
 
     def forget(self, source: str) -> None:
         with self._lock:
             self._sources.pop(str(source), None)
-            n = len(self._sources)
+            self._stale.pop(str(source), None)
+            n, n_stale = len(self._sources), len(self._stale)
         _metrics.FLEET_SOURCES.set(n)
+        _metrics.FLEET_STALE_SOURCES.set(n_stale)
+
+    def mark_stale(self, source: str, age_s: float) -> None:
+        """Evict one source for staleness but keep the tombstone (and
+        the observed age) for the health surface."""
+        with self._lock:
+            self._sources.pop(str(source), None)
+            self._stale[str(source)] = float(age_s)
+            n, n_stale = len(self._sources), len(self._stale)
+        _metrics.FLEET_SOURCES.set(n)
+        _metrics.FLEET_STALE_SOURCES.set(n_stale)
+
+    def stale(self) -> dict[str, float]:
+        """Stale-evicted sources -> last observed snapshot age (s)."""
+        with self._lock:
+            return dict(self._stale)
 
     def sources(self) -> list[str]:
         with self._lock:
@@ -127,7 +154,9 @@ class FleetAggregator:
     def reset(self) -> None:
         with self._lock:
             self._sources.clear()
+            self._stale.clear()
         _metrics.FLEET_SOURCES.set(0)
+        _metrics.FLEET_STALE_SOURCES.set(0)
 
 
 #: Process-global aggregator (the node's /metrics/fleet source).
@@ -164,17 +193,28 @@ def load_directory(
     aggregator: FleetAggregator | None = None,
     *,
     skip_pid: int | None = None,
+    max_age_s: float | None = None,
+    clock=time.time,
 ) -> list[str]:
     """Ingest every snapshot file in a fleet directory (skipping this
     process's own, by pid, so the local registry isn't merged twice).
     Returns the ingested source names; unreadable or version-mismatched
     files are skipped — a scrape must never fail on a half-written
-    sibling."""
+    sibling.
+
+    ``max_age_s`` is the staleness TTL: a snapshot whose ``taken_unix``
+    is older than that (against ``clock()``, injectable for tests) is
+    *not* ingested — it is evicted via :meth:`FleetAggregator.mark_stale`
+    so the dead host drops out of the merged series but stays visible
+    to ``/healthz`` and ``eigentrust_fleet_stale_sources``.  Without a
+    TTL the old keep-forever behavior holds (worker pools that publish
+    once and exit)."""
     aggregator = aggregator if aggregator is not None else FLEET
     directory = Path(directory)
     ingested: list[str] = []
     if not directory.is_dir():
         return ingested
+    now = clock() if max_age_s is not None else 0.0
     for path in sorted(directory.glob("fleet-*.json")):
         try:
             snap = json.loads(path.read_text())
@@ -185,6 +225,14 @@ def load_directory(
         if skip_pid is not None and snap.get("pid") == skip_pid:
             continue
         source = str(snap.get("source") or path.stem)
+        taken = snap.get("taken_unix")
+        if (
+            max_age_s is not None
+            and isinstance(taken, (int, float))
+            and now - float(taken) > float(max_age_s)
+        ):
+            aggregator.mark_stale(source, now - float(taken))
+            continue
         aggregator.ingest(source, snap)
         ingested.append(source)
     return ingested

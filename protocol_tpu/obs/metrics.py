@@ -647,6 +647,42 @@ POD_MANIFESTS_SEALED = METRICS.counter(
     "whose complete per-host shard stamp set was atomically bound "
     "into pod_manifest_e<N>.json (node/pod.py)",
 )
+POD_PHASE_SKEW_SECONDS = METRICS.histogram(
+    "eigentrust_pod_phase_skew_seconds",
+    "Per-phase pod skew: max minus median host duration for one "
+    "stitched pod epoch phase (plan/converge/checkpoint/wal_flush), "
+    "observed by the stitching host (obs/podtrace.py) — the "
+    "straggler-attribution signal behind the pod-phase-skew-p99 SLO",
+    labelnames=("phase",),
+    buckets=TIME_BUCKETS,
+)
+POD_BARRIER_WAIT_SECONDS = METRICS.gauge(
+    "eigentrust_pod_barrier_wait_seconds",
+    "Pre-collective barrier-arrival spread of the last stitched pod "
+    "epoch: latest minus earliest host arrival at the plan "
+    "dimension-agreement allgather (clock-aligned across hosts) — a "
+    "fast host pays exactly this long waiting inside the collective",
+)
+POD_STITCH_SECONDS = METRICS.gauge(
+    "eigentrust_pod_stitch_seconds",
+    "Wall-clock the stitching host spent aligning clocks and merging "
+    "the per-host span trees of the last pod epoch trace "
+    "(GET /trace/pod) — obs-plane overhead, budgeted <1% of the epoch",
+)
+POD_STRAGGLER = METRICS.gauge(
+    "eigentrust_pod_straggler",
+    "1 while the StragglerWatcher flags this host: its phase time "
+    "exceeded the pod median by the configured ratio for k consecutive "
+    "epochs (journaled as an anomaly on the flagging transition)",
+    labelnames=("host",),
+)
+FLEET_STALE_SOURCES = METRICS.gauge(
+    "eigentrust_fleet_stale_sources",
+    "Fleet snapshot sources evicted from the merged scrape because "
+    "their newest snapshot aged past the staleness TTL — a silently "
+    "dead pod host shows up here (and degrades /healthz) before any "
+    "collective hangs on it",
+)
 LOCK_WAIT_SECONDS = METRICS.histogram(
     "eigentrust_lock_wait_seconds",
     "Lock-acquisition wait time by allocation site — recorded only "
@@ -730,5 +766,10 @@ __all__ = [
     "POD_PLAN_REUSED",
     "POD_EPOCH_SECONDS",
     "POD_MANIFESTS_SEALED",
+    "POD_PHASE_SKEW_SECONDS",
+    "POD_BARRIER_WAIT_SECONDS",
+    "POD_STITCH_SECONDS",
+    "POD_STRAGGLER",
+    "FLEET_STALE_SOURCES",
     "LOCK_WAIT_SECONDS",
 ]

@@ -306,6 +306,103 @@ def install_defaults(engine: "SLOEngine | None" = None, **kwargs: Any) -> None:
         engine.register(objective)
 
 
+# ---------------------------------------------------------------------------
+# Pod objective set (multi-host runs only — a single-host node must not
+# carry objectives over signals it can never produce)
+# ---------------------------------------------------------------------------
+
+
+def _pod_phase_skew_p99() -> float | None:
+    """Worst per-phase skew p99 across the four stitched epoch phases
+    (None until the first stitch feeds the histogram)."""
+    from .podtrace import SKEW_PHASES
+
+    values = [
+        _metrics.POD_PHASE_SKEW_SECONDS.quantile(0.99, phase=phase)
+        for phase in SKEW_PHASES
+    ]
+    values = [v for v in values if v is not None]
+    return max(values) if values else None
+
+
+def _pod_stitch_missing() -> float | None:
+    """Hosts missing from the newest stitched pod trace (None before
+    any stitch)."""
+    from .podtrace import POD_TRACES
+
+    missing = POD_TRACES.last_missing_hosts()
+    return None if missing is None else float(missing)
+
+
+def _fleet_heartbeat_age() -> float | None:
+    """Age of the *stalest* fleet snapshot currently merged — per-host
+    heartbeat freshness (None with no sources; already-evicted stale
+    sources surface through the stale-sources gauge and /healthz)."""
+    from .fleet import FLEET
+
+    now = time.time()
+    ages = [
+        now - float(snap["taken_unix"])
+        for snap in FLEET.snapshots().values()
+        if isinstance(snap.get("taken_unix"), (int, float))
+    ]
+    return max(ages) if ages else None
+
+
+def pod_objectives(
+    *,
+    phase_skew_p99_s: float = 1.0,
+    heartbeat_max_age_s: float = 30.0,
+) -> list[SLObjective]:
+    """The pod-level objectives ISSUE 19 adds: skew, stitch
+    completeness, heartbeat freshness.  ``install_pod_defaults``
+    registers them alongside (not instead of) the node defaults."""
+    return [
+        SLObjective(
+            name="pod-phase-skew-p99",
+            description=(
+                "p99 of the per-phase pod skew (max - median host "
+                "duration, worst phase of plan/converge/checkpoint/"
+                "wal_flush) — a straggling host burns the whole pod's "
+                "collective time"
+            ),
+            target=float(phase_skew_p99_s),
+            value_fn=_pod_phase_skew_p99,
+            unit="seconds",
+        ),
+        SLObjective(
+            name="pod-stitch-completeness",
+            description=(
+                "hosts missing from the newest stitched pod epoch "
+                "trace — every live host must publish its span tree"
+            ),
+            target=0.0,
+            value_fn=_pod_stitch_missing,
+            unit="hosts",
+        ),
+        SLObjective(
+            name="pod-heartbeat-freshness",
+            description=(
+                "age of the stalest per-host metric snapshot in the "
+                "fleet exchange — a silently dead host violates here "
+                "before any gloo collective hangs on it"
+            ),
+            target=float(heartbeat_max_age_s),
+            value_fn=_fleet_heartbeat_age,
+            unit="seconds",
+        ),
+    ]
+
+
+def install_pod_defaults(
+    engine: "SLOEngine | None" = None, **kwargs: Any
+) -> None:
+    """Register the pod objective set (multi-host boot / pod dryrun)."""
+    engine = engine if engine is not None else SLO_ENGINE
+    for objective in pod_objectives(**kwargs):
+        engine.register(objective)
+
+
 def seed_violation(engine: "SLOEngine | None" = None) -> SLObjective:
     """Register an objective that cannot pass — the CI self-check that
     a violating objective actually fails the dryrun gate."""
@@ -338,5 +435,7 @@ __all__ = [
     "SLO_ENGINE",
     "default_objectives",
     "install_defaults",
+    "install_pod_defaults",
+    "pod_objectives",
     "seed_violation",
 ]

@@ -199,6 +199,11 @@ class Tracer:
             if root is not None:
                 with self._lock:
                     trace = root.to_dict()
+                    # The serialized tree is relative (start_offset_s);
+                    # the root's monotonic start anchors it on this
+                    # host's clock so the pod stitcher (obs/podtrace)
+                    # can place N trees on one aligned timeline.
+                    trace["start_monotonic"] = round(root.start_monotonic, 6)
                     self._traces[epoch_number] = trace
                     # Early-arrived grafts (a proof that landed while
                     # this root span was still open) attach now.

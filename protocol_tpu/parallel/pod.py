@@ -145,6 +145,19 @@ class PodWindowPlan:
     owner: np.ndarray  # (n,) int32 peer→host owner map
     local_edges: int  # edges this host's partition holds
     build_seconds: float  # local plan construction wall-clock
+    #: Pre-collective barrier probe (ISSUE 19): when this host entered
+    #: the dimension-agreement allgather (caller's monotonic clock) and
+    #: how long both agreement rounds blocked — the pod trace stitcher
+    #: clock-aligns the arrival stamps into the barrier-arrival spread
+    #: (eigentrust_pod_barrier_wait_seconds).  0.0 without a clock.
+    barrier_enter_monotonic: float = 0.0
+    barrier_wait_seconds: float = 0.0
+    #: One monotonic↔wall clock-sync sample pair taken at build entry
+    #: (both clocks read back-to-back) — one of the samples the
+    #: stitcher's per-host offset estimation feeds on.  0.0 without
+    #: injected clocks.
+    sync_monotonic: float = 0.0
+    sync_unix: float = 0.0
 
     @classmethod
     def build(
@@ -156,6 +169,7 @@ class PodWindowPlan:
         delta_rows: np.ndarray | None = None,
         interpret: bool | None = None,
         clock: Callable[[], float] | None = None,
+        wall: Callable[[], float] | None = None,
     ) -> "PodWindowPlan":
         """Partition the graph by source-peer owner, resolve this
         host's local plan (reuse / delta / rebuild against the local
@@ -164,10 +178,14 @@ class PodWindowPlan:
         sharded arrays.  ``plan`` is this host's cached *local* plan
         (checkpoint-shard restored); ``delta_rows`` is the global
         churn hint, clipped to owned rows here.  ``clock`` is the
-        caller's monotonic clock for the ``build_seconds`` field —
-        instrumentation wraps kernel trees from the outside (graftlint
-        clock-in-kernel-tree doctrine), so without one the field
-        stays 0.0."""
+        caller's monotonic clock for the ``build_seconds`` field and
+        the barrier probe; ``wall`` is the caller's wall clock, read
+        back-to-back with ``clock`` at entry for the pod stitcher's
+        clock-sync sample — instrumentation wraps kernel trees from
+        the outside (graftlint clock-in-kernel-tree doctrine), so
+        without them the probe fields stay 0.0."""
+        sync_monotonic = clock() if clock is not None else 0.0
+        sync_unix = wall() if wall is not None else 0.0
         g = graph.drop_self_edges()
         w, dangling = g.row_normalized()
         owner = pod.partition.assign_ids(g.n)
@@ -199,6 +217,12 @@ class PodWindowPlan:
         # cut depends on it), then per-shard run capacity.
         L = pod.local_shards
         min_rps = -(-plan.n_rows // (L * BLOCK_ROWS)) * BLOCK_ROWS
+        # Barrier probe: the first allgather below is the pod's
+        # pre-collective barrier — the first host to arrive blocks
+        # until the last one does, so the clock-aligned enter stamps
+        # across hosts ARE the arrival spread, and the elapsed time
+        # over both agreement rounds is this host's wait.
+        barrier_enter = clock() if clock is not None else 0.0
         rows_per_shard = int(_pod_max(pod, np.asarray([min_rps]))[0])
         live_end = plan.seg_end[: plan.n_segments]
         counts = np.bincount(
@@ -206,6 +230,7 @@ class PodWindowPlan:
         )
         min_smax = -(-max(int(counts.max()), 1) // 1024) * 1024
         s_max = int(_pod_max(pod, np.asarray([min_smax]))[0])
+        barrier_wait = clock() - barrier_enter if clock is not None else 0.0
 
         parts = _partition_plan_arrays(
             plan, L, rows_per_shard=rows_per_shard, s_max=s_max
@@ -253,6 +278,10 @@ class PodWindowPlan:
             owner=owner,
             local_edges=int(lsrc.shape[0]),
             build_seconds=build_seconds,
+            barrier_enter_monotonic=barrier_enter,
+            barrier_wait_seconds=barrier_wait,
+            sync_monotonic=sync_monotonic,
+            sync_unix=sync_unix,
         )
 
     def t0(self) -> jax.Array:

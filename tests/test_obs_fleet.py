@@ -332,6 +332,63 @@ class TestFleetAggregation:
         assert load_directory(tmp_path, agg, skip_pid=os.getpid()) == []
 
 
+class TestFleetStaleness:
+    """Snapshot TTL (ISSUE 19): a silently dead host's last snapshot
+    must drop out of the merge and surface as stale, not be trusted
+    forever."""
+
+    def _decoy(self, tmp_path, name: str, age_s: float, now: float):
+        """A snapshot whose taken_unix is ``age_s`` in the fake past."""
+        reg = type(METRICS)()
+        reg.counter(f"eigentrust_test_stale_{name}_total", "t").inc(1)
+        path = publish_snapshot(tmp_path, name, reg)
+        snap = json.loads(path.read_text())
+        snap["taken_unix"] = now - age_s
+        path.write_text(json.dumps(snap))
+
+    def test_decoy_stale_snapshot_evicted(self, tmp_path):
+        from protocol_tpu.obs.metrics import FLEET_STALE_SOURCES
+
+        now = 1_000_000.0
+        self._decoy(tmp_path, "dead", age_s=120.0, now=now)
+        self._decoy(tmp_path, "live", age_s=3.0, now=now)
+        agg = FleetAggregator()
+        ingested = load_directory(
+            tmp_path, agg, max_age_s=30.0, clock=lambda: now
+        )
+        assert ingested == ["proc-live"]
+        assert agg.sources() == ["proc-live"]
+        assert agg.stale() == {"proc-dead": pytest.approx(120.0)}
+        assert FLEET_STALE_SOURCES.value() == 1.0
+        text = fleet_prometheus_text(aggregator=agg)
+        assert "eigentrust_test_stale_live_total" in text
+        assert "eigentrust_test_stale_dead_total" not in text
+        agg.reset()
+        assert FLEET_STALE_SOURCES.value() == 0.0
+
+    def test_fresh_reingest_clears_stale_mark(self, tmp_path):
+        now = 1_000_000.0
+        self._decoy(tmp_path, "flappy", age_s=120.0, now=now)
+        agg = FleetAggregator()
+        load_directory(tmp_path, agg, max_age_s=30.0, clock=lambda: now)
+        assert "proc-flappy" in agg.stale()
+        self._decoy(tmp_path, "flappy", age_s=1.0, now=now)  # came back
+        load_directory(tmp_path, agg, max_age_s=30.0, clock=lambda: now)
+        assert agg.stale() == {}
+        assert agg.sources() == ["proc-flappy"]
+        agg.reset()
+
+    def test_no_ttl_keeps_old_snapshots(self, tmp_path):
+        # Worker pools publish once and exit; without a TTL the old
+        # keep-forever behavior must hold.
+        now = 1_000_000.0
+        self._decoy(tmp_path, "old", age_s=9_999.0, now=now)
+        agg = FleetAggregator()
+        assert load_directory(tmp_path, agg) == ["proc-old"]
+        assert agg.stale() == {}
+        agg.reset()
+
+
 # ---------------------------------------------------------------------------
 # SLO engine
 # ---------------------------------------------------------------------------

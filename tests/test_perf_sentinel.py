@@ -449,6 +449,51 @@ class TestObsFreshnessSeries:
         assert any("freshness_p99_ms" in k for k in report["series"])
 
 
+class TestPodObsSeries:
+    def test_pod_obs_rounds_gate_with_n_hosts_key(self, tmp_path):
+        """ISSUE 19: pod OBS rounds carry ``n_hosts`` so the stitch /
+        skew series never collide with the single-host freshness
+        series; stitch_ms and phase_skew_p99_ms gate upward."""
+        for i, (stitch, skew) in enumerate([(0.5, 8.0), (4.0, 60.0)], start=1):
+            (tmp_path / f"OBS_r{i:02d}.json").write_text(
+                json.dumps(
+                    {
+                        "n": i,
+                        "tool": "dryrun_pod",
+                        "n_hosts": 2,
+                        "entries": [
+                            {
+                                "metric": "pod trace stitch + phase skew",
+                                "value": stitch,
+                                "unit": "ms",
+                                "n_hosts": 2,
+                                "stitch_ms": stitch,
+                                "phase_skew_p99_ms": skew,
+                            }
+                        ],
+                    }
+                )
+            )
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1  # r02 regressed both pod series vs r01
+        report = json.loads(out.read_text())
+        assert {
+            "pod trace stitch + phase skew :: stitch_ms [n_hosts=2]",
+            "pod trace stitch + phase skew :: phase_skew_p99_ms [n_hosts=2]",
+        } <= set(report["regressions"])
+
+    def test_committed_pod_obs_round_feeds_the_gate(self, tmp_path):
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(REPO), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert any("OBS_r02.json" in f for f in report["history_files"])
+        assert any(
+            "stitch_ms [n_hosts=2]" in k for k in report["series"]
+        )
+
+
 class TestChaosRecoverySeries:
     def test_chaos_rounds_feed_the_gate(self, tmp_path):
         """ISSUE 14: CHAOS_r*.json is in the default globs, its

@@ -268,6 +268,12 @@ def worker_main(args) -> int:
     from protocol_tpu.node.epoch import Epoch
     from protocol_tpu.node.pod import PodDurability
     from protocol_tpu.obs import metrics as obs_metrics
+    from protocol_tpu.obs import podtrace as obs_podtrace
+    from protocol_tpu.obs.fleet import load_directory, publish_snapshot
+    from protocol_tpu.obs.journal import JOURNAL, install_worker_dump_handler
+    from protocol_tpu.obs.slo import SLO_ENGINE, install_pod_defaults
+    from protocol_tpu.obs.trace import TRACER
+    from protocol_tpu.obs.watchers import STRAGGLERS
     from protocol_tpu.parallel.mesh import SHARD_AXIS
     from protocol_tpu.parallel.pod import PodContext, PodWindowPlan
     from protocol_tpu.parallel.sharded import converge_sharded
@@ -279,6 +285,20 @@ def worker_main(args) -> int:
     obs_metrics.POD_HOST_ID.set(host)
     pd = PodDurability(args.state_dir, host, pod.n_hosts)
     ack_path = Path(args.state_dir) / f"acks-h{host:03d}.jsonl"
+
+    # Pod observability plumbing (ISSUE 19): per-epoch trace + metric
+    # snapshots ride the shared exchange; flight-recorder rings dump
+    # per epoch (and on SIGTERM) so a crashed host's last events
+    # survive for the parent to collect.
+    fleet_dir = Path(args.state_dir) / "fleet"
+    flight_dir = Path(args.state_dir) / "flight"
+    flight_dir.mkdir(parents=True, exist_ok=True)
+    install_worker_dump_handler(flight_dir, "pod")
+    straggler_host = min(1, pod.n_hosts - 1) if args.seed_straggler else -1
+    if host == 0:
+        STRAGGLERS.configure(
+            ratio=args.straggler_ratio, k=args.straggler_k, min_seconds=0.1
+        )
 
     # -- recovery (resume runs): newest sealed manifest + own shards --
     start_epoch, scores, plan = 0, None, None
@@ -355,6 +375,7 @@ def worker_main(args) -> int:
         )
 
     epochs_detail = []
+    stitch_reports: list[dict] = []
     scrape = None
     prev_dims = None
     ok = True
@@ -364,93 +385,127 @@ def worker_main(args) -> int:
         if e > 0:
             rows, cur, (ns, nd, nw) = churn_epoch(cur, e, args)
         t_epoch = time.perf_counter()
-        if e > 0:
-            deg = ns.shape[0] // rows.shape[0]
-            owned_idx = np.flatnonzero(owner[rows] == host)
-            owned_count = int(owned_idx.size)
-            payloads = [
-                encode_row(
-                    e, int(rows[i]),
-                    nd[i * deg:(i + 1) * deg], nw[i * deg:(i + 1) * deg],
+        with TRACER.epoch(e):
+            if e > 0:
+                with TRACER.span("wal_flush"):
+                    deg = ns.shape[0] // rows.shape[0]
+                    owned_idx = np.flatnonzero(owner[rows] == host)
+                    owned_count = int(owned_idx.size)
+                    payloads = [
+                        encode_row(
+                            e, int(rows[i]),
+                            nd[i * deg:(i + 1) * deg],
+                            nw[i * deg:(i + 1) * deg],
+                        )
+                        for i in owned_idx
+                    ]
+                    digest = hashlib.sha256(b"".join(payloads)).hexdigest()
+                    if e in replayed:
+                        # Already durable + acknowledged before the
+                        # crash; the recovery audit verified the WAL
+                        # shard replays it, so re-journaling would only
+                        # duplicate records.  The regenerated stream
+                        # must still agree with what was acked — the
+                        # reconstruction cross-check.
+                        if replayed[e]["digest"] != digest:
+                            recovery.setdefault(
+                                "replay_stream_mismatch", []
+                            ).append(e)
+                            ok = False
+                    else:
+                        for pbytes in payloads:
+                            last_seq = pd.wal.append(pbytes, flush=False)
+                        pd.wal.flush()
+                        with ack_path.open("a") as f:
+                            f.write(json.dumps({
+                                "epoch": e,
+                                "count": len(payloads),
+                                "digest": digest,
+                                "wal_to": last_seq,
+                            }) + "\n")
+                            f.flush()
+                            os.fsync(f.fileno())
+                if args.crash_host == host and args.crash_epoch == e:
+                    # kill -9 analog: acked data is on disk, nothing else
+                    # is — recovery must replay exactly this epoch's rows.
+                    # The flight ring dump is the crash handler's last
+                    # act (same contract as the SIGTERM dump handler).
+                    JOURNAL.dump(
+                        flight_dir / f"flight-pod-h{host:03d}.jsonl",
+                        reason=f"crash-epoch-{e}",
+                    )
+                    os._exit(137)
+
+            with TRACER.span("plan"):
+                t_plan = time.perf_counter()
+                # time.monotonic (not perf_counter) so the barrier and
+                # sync stamps share a clock base with the
+                # clock_sync_samples() pairs the stitcher aligns on.
+                podplan = PodWindowPlan.build(
+                    cur, pod, plan=plan, delta_rows=rows,
+                    clock=time.monotonic, wall=time.time,
                 )
-                for i in owned_idx
-            ]
-            digest = hashlib.sha256(b"".join(payloads)).hexdigest()
-            if e in replayed:
-                # Already durable + acknowledged before the crash; the
-                # recovery audit verified the WAL shard replays it, so
-                # re-journaling would only duplicate records.  The
-                # regenerated stream must still agree with what was
-                # acked — the reconstruction cross-check.
-                if replayed[e]["digest"] != digest:
-                    recovery.setdefault("replay_stream_mismatch", []).append(e)
-                    ok = False
-            else:
-                for pbytes in payloads:
-                    last_seq = pd.wal.append(pbytes, flush=False)
-                pd.wal.flush()
-                with ack_path.open("a") as f:
-                    f.write(json.dumps({
-                        "epoch": e,
-                        "count": len(payloads),
-                        "digest": digest,
-                        "wal_to": last_seq,
-                    }) + "\n")
-                    f.flush()
-                    os.fsync(f.fileno())
-            if args.crash_host == host and args.crash_epoch == e:
-                # kill -9 analog: acked data is on disk, nothing else
-                # is — recovery must replay exactly this epoch's rows.
-                os._exit(137)
+                plan_update_seconds = time.perf_counter() - t_plan
+                plan = podplan.plan
 
-        t_plan = time.perf_counter()
-        podplan = PodWindowPlan.build(
-            cur, pod, plan=plan, delta_rows=rows, clock=time.perf_counter
-        )
-        plan_update_seconds = time.perf_counter() - t_plan
-        plan = podplan.plan
+            dims = (podplan.rows_per_shard, podplan.table_entries,
+                    podplan.s_max)
+            with TRACER.span("converge"):
+                if dims != prev_dims:
+                    # Eat the jit compile outside the timed region
+                    # (bench.py's warm-up policy); recompiles are
+                    # counted per epoch.
+                    converge_sharded(
+                        podplan, alpha=0.1, tol=args.tol,
+                        max_iter=args.max_iter, t0=scores,
+                    )
+                t_conv = time.perf_counter()
+                t, iters, resid = converge_sharded(
+                    podplan, alpha=0.1, tol=args.tol,
+                    max_iter=args.max_iter, t0=scores,
+                )
+                converge_seconds = time.perf_counter() - t_conv
+            scores = np.asarray(t)
 
-        dims = (podplan.rows_per_shard, podplan.table_entries, podplan.s_max)
-        if dims != prev_dims:
-            # Eat the jit compile outside the timed region (bench.py's
-            # warm-up policy); recompiles are counted per epoch.
-            converge_sharded(
-                podplan, alpha=0.1, tol=args.tol, max_iter=args.max_iter,
-                t0=scores,
-            )
-        t_conv = time.perf_counter()
-        t, iters, resid = converge_sharded(
-            podplan, alpha=0.1, tol=args.tol, max_iter=args.max_iter, t0=scores
-        )
-        converge_seconds = time.perf_counter() - t_conv
-        scores = np.asarray(t)
+            if scrape is None and not args.skip_scrape:
+                with TRACER.span("scrape"):
+                    scrape = _scrape(podplan, int(cur.nnz))
+                ok = ok and scrape["ok"]
 
-        if scrape is None and not args.skip_scrape:
-            scrape = _scrape(podplan, int(cur.nnz))
-            ok = ok and scrape["ok"]
-
-        # Durability: local shard checkpoint -> stamp -> (host 0) seal.
-        m = owner[cur.src] == host
-        lg = TrustGraph(
-            cur.n, cur.src[m], cur.dst[m], cur.weight[m], cur.pre_trusted
-        )
-        pd.checkpoints.save(
-            Epoch(e), lg, scores=scores, plan=plan, wal_seq=last_seq
-        )
-        entry = pd.checkpoints.manifest_entry(Epoch(e))
-        sdig = hashlib.sha256(scores.tobytes()).hexdigest()
-        pd.publish_shard(
-            e, wal_seq=last_seq, columns=entry["columns"],
-            extra={"scores_sha256": sdig, "residual": float(resid)},
-        )
-        sealed = None
-        if host == 0:
-            deadline = time.monotonic() + args.seal_timeout
-            while sealed is None and time.monotonic() < deadline:
-                sealed = pd.seal_epoch(e)
-                if sealed is None:
-                    time.sleep(0.02)
-            ok = ok and sealed is not None
+            # Durability: local shard checkpoint -> stamp -> host 0 seal.
+            with TRACER.span("checkpoint"):
+                if host == straggler_host and e > 0:
+                    # Seeded straggler rides a LOCAL phase: converge is
+                    # collective-synchronized, so a pre-converge sleep
+                    # would elongate every host's converge span equally
+                    # and produce zero skew.
+                    time.sleep(args.straggler_sleep)
+                m = owner[cur.src] == host
+                lg = TrustGraph(
+                    cur.n, cur.src[m], cur.dst[m], cur.weight[m],
+                    cur.pre_trusted,
+                )
+                pd.checkpoints.save(
+                    Epoch(e), lg, scores=scores, plan=plan, wal_seq=last_seq
+                )
+                entry = pd.checkpoints.manifest_entry(Epoch(e))
+                sdig = hashlib.sha256(scores.tobytes()).hexdigest()
+                pd.publish_shard(
+                    e, wal_seq=last_seq, columns=entry["columns"],
+                    extra={"scores_sha256": sdig, "residual": float(resid)},
+                )
+            sealed = None
+            if host == 0:
+                # Sealing waits on every host's stamp, so it gets its
+                # OWN span — folded into `checkpoint` it would read as
+                # host-0 checkpoint skew whenever a peer runs late.
+                with TRACER.span("seal"):
+                    deadline = time.monotonic() + args.seal_timeout
+                    while sealed is None and time.monotonic() < deadline:
+                        sealed = pd.seal_epoch(e)
+                        if sealed is None:
+                            time.sleep(0.02)
+                ok = ok and sealed is not None
 
         epoch_seconds = time.perf_counter() - t_epoch
         obs_metrics.POD_OWNED_PEERS.set(int((owner == host).sum()))
@@ -460,6 +515,54 @@ def worker_main(args) -> int:
         obs_metrics.POD_EPOCH_SECONDS.set(epoch_seconds)
         if sealed is not None:
             obs_metrics.POD_MANIFESTS_SEALED.inc()
+
+        # Pod obs exchange: ship this epoch's span tree + clock-sync
+        # burst + barrier probe, refresh the heartbeat snapshot, dump
+        # the flight ring (the parent collects the tails), and — host 0
+        # — stitch the pod trace once every host has published.
+        t_obs = time.perf_counter()
+        sync = obs_podtrace.clock_sync_samples()
+        if podplan.sync_unix > 0.0:
+            sync.append({
+                "monotonic": podplan.sync_monotonic,
+                "unix": podplan.sync_unix,
+            })
+        obs_podtrace.publish_epoch_trace(
+            fleet_dir, host, e,
+            sync=sync,
+            barrier={
+                "enter_monotonic": podplan.barrier_enter_monotonic,
+                "wait_seconds": podplan.barrier_wait_seconds,
+            },
+        )
+        publish_snapshot(fleet_dir, f"h{host:03d}")
+        obs_publish_seconds = time.perf_counter() - t_obs
+
+        stitch_summary = None
+        if host == 0 and pod.n_hosts > 1:
+            obs_deadline = time.monotonic() + args.seal_timeout
+            while (
+                len(obs_podtrace.directory_hosts(fleet_dir, e)) < pod.n_hosts
+                and time.monotonic() < obs_deadline
+            ):
+                time.sleep(0.02)
+            stitched = obs_podtrace.stitch_epoch(
+                fleet_dir, e, expected_hosts=pod.n_hosts, graft_into=TRACER
+            )
+            load_directory(fleet_dir, skip_pid=os.getpid(), max_age_s=30.0)
+            if stitched is not None:
+                stitch_summary = {
+                    "epoch": e,
+                    "complete": stitched["complete"],
+                    "missing_hosts": stitched["missing_hosts"],
+                    "stitch_seconds": stitched["stitch_seconds"],
+                    "phase_skew_s": stitched["phase_skew_s"],
+                    "barrier_spread_s": stitched["barrier"]["spread_s"],
+                    "phase_attribution": stitched["phase_attribution"],
+                    "stragglers": stitched.get("stragglers", []),
+                }
+                stitch_reports.append(stitch_summary)
+
         epochs_detail.append({
             "epoch": e,
             "seconds": round(epoch_seconds, 4),
@@ -474,8 +577,23 @@ def worker_main(args) -> int:
             "owned_rows": owned_count,
             "recompiled": dims != prev_dims,
             "sealed": (sealed is not None) if host == 0 else None,
+            "phase_seconds": {
+                p: round(d, 4)
+                for p, d in obs_podtrace.phase_durations(
+                    TRACER.get_trace(e) or {}
+                ).items()
+            },
+            "obs_publish_seconds": round(obs_publish_seconds, 4),
+            "stitch": stitch_summary,
         })
         prev_dims = dims
+
+    # End-of-run flight ring dump — the per-host tail the parent ships
+    # into the pod artifact (crash paths dump via the crash-exit hook
+    # and the SIGTERM handler instead).
+    JOURNAL.dump(
+        flight_dir / f"flight-pod-h{host:03d}.jsonl", reason="run-end"
+    )
 
     if args.dump_scores and host == 0:
         np.save(args.dump_scores, scores)
@@ -484,6 +602,80 @@ def worker_main(args) -> int:
         ok = ok and not recovery["lost_acked_epochs"]
         ok = ok and recovery.get("checkpoint_matches_stream", True)
     ok = ok and abs(float(scores.sum()) - 1.0) < 1e-3
+
+    # -- pod obs verdict (host 0, multi-host pods) --------------------
+    pod_obs = None
+    if host == 0 and pod.n_hosts > 1 and stitch_reports:
+        install_pod_defaults(
+            phase_skew_p99_s=args.skew_slo_target, heartbeat_max_age_s=30.0
+        )
+        slo_doc = SLO_ENGINE.evaluate()
+        flagged = sorted(STRAGGLERS.flagged())
+        complete_all = all(r["complete"] for r in stitch_reports)
+        steady = [r for r in stitch_reports if r["epoch"] > 0]
+        attrs = [
+            v for r in steady for v in r["phase_attribution"].values()
+        ]
+        min_attr = min(attrs) if attrs else None
+        # Serve through the real node route — the acceptance probe is
+        # GET /trace/pod/latest with every host present in the stitch.
+        from protocol_tpu.node.server import handle_request
+
+        status_code, body = handle_request("GET", "/trace/pod/latest", None)
+        trace_latest = json.loads(body) if status_code == 200 else None
+        served_ok = (
+            status_code == 200
+            and trace_latest is not None
+            and sorted(trace_latest.get("hosts", []))
+            == list(range(pod.n_hosts))
+        )
+        # Obs overhead: publish + stitch cost against the steady epoch
+        # wall-clock (the <1% acceptance bar; seeded runs are skewed by
+        # design, so the bar applies to clean runs only).
+        steady_detail = [d for d in epochs_detail if d["epoch"] > 0]
+        obs_cost = sum(
+            d["obs_publish_seconds"]
+            + ((d.get("stitch") or {}).get("stitch_seconds") or 0.0)
+            for d in steady_detail
+        )
+        steady_seconds = sum(d["seconds"] for d in steady_detail)
+        overhead_pct = (
+            round(100.0 * obs_cost / steady_seconds, 4)
+            if steady_detail and steady_seconds
+            else None
+        )
+        pod_obs = {
+            "stitch_reports": stitch_reports,
+            "stitch_complete": complete_all,
+            "min_phase_attribution": min_attr,
+            "trace_pod_served": served_ok,
+            "trace_latest": trace_latest,
+            "obs_overhead_pct": overhead_pct,
+            "straggler_flagged": flagged,
+            "seeded_straggler": bool(args.seed_straggler),
+            "skew_slo": slo_doc["objectives"].get("pod-phase-skew-p99"),
+            "slo_ok": slo_doc["ok"],
+            "slo": {
+                name: {"ok": obj["ok"], "value": obj["value"]}
+                for name, obj in slo_doc["objectives"].items()
+            },
+        }
+        if args.seed_straggler:
+            pod_obs["seeded_straggler_fired"] = bool(flagged) and not (
+                slo_doc["objectives"]
+                .get("pod-phase-skew-p99", {})
+                .get("ok", True)
+            )
+        # Gate: a complete served stitch with green SLOs and no
+        # straggler is the healthy verdict; a seeded straggler MUST
+        # flip it (the CI must-fail leg checks exit-nonzero).
+        ok = ok and complete_all and served_ok
+        ok = ok and slo_doc["ok"] and not flagged
+        if not args.seed_straggler:
+            ok = ok and (min_attr is None or min_attr >= 0.9)
+            ok = ok and (overhead_pct is None or overhead_pct < 1.0)
+        result.update(pod_obs=pod_obs)
+
     result.update(
         backend=BACKEND,
         n_hosts=pod.n_hosts,
@@ -581,7 +773,35 @@ def _passthrough(args) -> list[str]:
         "--seed", str(args.seed), "--tol", str(args.tol),
         "--max-iter", str(args.max_iter),
         "--seal-timeout", str(args.seal_timeout),
-    ] + (["--skip-scrape"] if args.skip_scrape else [])
+        "--straggler-sleep", str(args.straggler_sleep),
+        "--skew-slo-target", str(args.skew_slo_target),
+        "--straggler-ratio", str(args.straggler_ratio),
+        "--straggler-k", str(args.straggler_k),
+    ] + (["--skip-scrape"] if args.skip_scrape else []) + (
+        ["--seed-straggler"] if args.seed_straggler else []
+    )
+
+
+def collect_pod_flight_tails(flight_dir: Path, tail_events: int = 20) -> dict:
+    """Per-host flight-recorder tails from the workers' per-epoch ring
+    dumps — ``collect_worker_dumps`` semantics (bounded tail, journaled
+    into the parent's ring, files consumed), grouped per host by
+    staging each dump into its own directory first."""
+    from protocol_tpu.obs.journal import collect_worker_dumps
+
+    tails: dict[str, list] = {}
+    if not flight_dir.is_dir():
+        return tails
+    for path in sorted(flight_dir.glob("flight-pod-*.jsonl")):
+        hostkey = path.stem.removeprefix("flight-pod-")
+        staging = flight_dir / f"collect-{hostkey}"
+        staging.mkdir(exist_ok=True)
+        path.rename(staging / path.name)
+        tails[hostkey] = collect_worker_dumps(
+            staging, "pod", tail_events=tail_events
+        )
+        staging.rmdir()
+    return tails
 
 
 def launch_pod(args, state_dir: Path, out_dir: Path, *, resume=False,
@@ -754,6 +974,19 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-scrape", action="store_true")
     ap.add_argument("--round", type=int, default=0)
     ap.add_argument("--workdir", default=None)
+    ap.add_argument(
+        "--seed-straggler", action="store_true",
+        help="inject a sleep in one host's checkpoint phase; the "
+             "straggler watcher and skew SLO MUST fire (exit 1)",
+    )
+    ap.add_argument("--straggler-sleep", type=float, default=0.5)
+    ap.add_argument("--skew-slo-target", type=float, default=0.2)
+    ap.add_argument("--straggler-ratio", type=float, default=1.5)
+    ap.add_argument("--straggler-k", type=int, default=2)
+    ap.add_argument(
+        "--obs-out", default=None,
+        help="also write the OBS_r*.json pod series for perf_sentinel",
+    )
     # hidden subprocess plumbing
     ap.add_argument("--worker", type=int, default=None, help=argparse.SUPPRESS)
     ap.add_argument("--reference", action="store_true", help=argparse.SUPPRESS)
@@ -807,6 +1040,7 @@ def main(argv: list[str] | None = None) -> int:
         if not args.skip_reference and args.processes > 1:
             sh_args = argparse.Namespace(**vars(args))
             sh_args.processes = 1
+            sh_args.seed_straggler = False
             sh_args.peers = max(args.peers // args.processes, 64)
             sh_args.edges = max(args.edges // args.processes, 256)
             sh_state = workdir / "single-host-state"
@@ -832,6 +1066,16 @@ def main(argv: list[str] | None = None) -> int:
         )
         skipped = all(w.get("skipped") for w in workers)
         identity = _bit_identity(workers)
+        if skipped and args.seed_straggler:
+            # The CI must-fail leg inverts our exit code; a skip-exit-0
+            # there would read as "watcher fired" on a jax build that
+            # never ran the pod at all.
+            print(
+                "dryrun_pod: SKIPPED but --seed-straggler expects a "
+                "failure — exiting 1 so the must-fail leg stays honest"
+            )
+            return 1
+        flight_tails = collect_pod_flight_tails(state / "flight")
 
         warm_vs_cold_l1 = None
         if not skipped and ref_scores.exists() and pod_scores.exists():
@@ -842,6 +1086,12 @@ def main(argv: list[str] | None = None) -> int:
         chaos = None
         if args.chaos_host_loss and not skipped and args.processes > 1:
             chaos = chaos_host_loss(args, workdir, workers)
+            chaos["flight_tails"] = {
+                k: len(v)
+                for k, v in collect_pod_flight_tails(
+                    workdir / "chaos-state" / "flight"
+                ).items()
+            }
     finally:
         if tmp_ctx is not None:
             tmp_ctx.cleanup()
@@ -935,6 +1185,40 @@ def main(argv: list[str] | None = None) -> int:
                 "pod_budget_peak_bytes":
                     scrape["pod_budget"]["peak_bytes"],
             })
+        pod_obs = w0.get("pod_obs") or {}
+        stitch_reports = pod_obs.get("stitch_reports") or []
+        if stitch_reports:
+            skews = [
+                max(r["phase_skew_s"].values()) if r["phase_skew_s"] else 0.0
+                for r in stitch_reports
+            ]
+            spreads = [
+                r["barrier_spread_s"] for r in stitch_reports
+                if r.get("barrier_spread_s") is not None
+            ]
+            stitch_ms = round(
+                (_median([r["stitch_seconds"] for r in stitch_reports])
+                 or 0.0) * 1e3, 3,
+            )
+            entries.append({
+                "metric": (
+                    f"pod trace stitch + phase skew ({scale}, "
+                    f"{meshs} mesh)"
+                ),
+                "value": stitch_ms,
+                "unit": "ms",
+                "n_hosts": args.processes,
+                "stitch_ms": stitch_ms,
+                "phase_skew_p99_ms": round(max(skews) * 1e3, 3),
+                "barrier_spread_ms": (
+                    round((_median(spreads) or 0.0) * 1e3, 3)
+                    if spreads else None
+                ),
+                "obs_overhead_pct": pod_obs.get("obs_overhead_pct"),
+                "stitch_complete": pod_obs.get("stitch_complete"),
+                "min_phase_attribution":
+                    pod_obs.get("min_phase_attribution"),
+            })
 
     report = {
         "tool": "dryrun_pod",
@@ -958,11 +1242,34 @@ def main(argv: list[str] | None = None) -> int:
         "single_host": single_host,
         "chaos": chaos,
         "entries": entries,
+        "flight_tails": flight_tails if not skipped else {},
         "workers": workers,
     }
     Path(args.out).write_text(
         json.dumps(report, indent=2, default=_jsonable) + "\n"
     )
+    # The stitched pod trace as its own artifact (CI uploads it), plus
+    # the sentinel-walkable OBS series when asked.
+    pod_trace_doc = next(
+        (
+            w["pod_obs"]["trace_latest"]
+            for w in workers
+            if isinstance(w.get("pod_obs"), dict)
+            and w["pod_obs"].get("trace_latest")
+        ),
+        None,
+    )
+    if pod_trace_doc is not None:
+        Path(args.out).with_name("POD_TRACE_latest.json").write_text(
+            json.dumps(pod_trace_doc, indent=2, default=_jsonable) + "\n"
+        )
+    if args.obs_out and not skipped:
+        Path(args.obs_out).write_text(json.dumps({
+            "tool": "dryrun_pod",
+            "round": args.round,
+            "n_hosts": args.processes,
+            "entries": [e for e in entries if "stitch_ms" in e],
+        }, indent=2, default=_jsonable) + "\n")
     status = (
         "SKIPPED (no multi-process CPU collectives)" if skipped
         else ("OK" if ok else "FAILED")

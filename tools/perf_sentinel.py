@@ -71,6 +71,12 @@ _FIELDS = {
     # other hot path.
     "freshness_p99_ms": True,
     "obs_overhead_pct": True,
+    # Pod-observability rounds (OBS_r*.json with n_hosts > 1, ISSUE
+    # 19): host-0 stitch latency for the pod epoch trace and the
+    # worst clock-aligned per-phase host skew — a pod that starts
+    # dragging a phase regresses this series before it trips the SLO.
+    "stitch_ms": True,
+    "phase_skew_p99_ms": True,
     # Pass-8 comm scrape (MULTICHIP/LADDER rounds): per-iteration
     # collective wire volume of the sharded composites — a partitioner
     # surprise that inflates traffic regresses this series upward.
