@@ -63,6 +63,14 @@ def main(argv: list[str] | None = None) -> int:
         "--prover", default="plonk", choices=("plonk", "commitment")
     )
     ap.add_argument(
+        "--zk-backend",
+        default="native",
+        choices=("native", "graft"),
+        help="proving-kernel backend stamped on every ProofJob "
+        "(ISSUE 20): proofs are byte-identical either way, the knob "
+        "moves where the MSM/NTT seconds are spent",
+    )
+    ap.add_argument(
         "--chaos",
         type=int,
         default=0,
@@ -97,8 +105,15 @@ def main(argv: list[str] | None = None) -> int:
     from tools.prover_pipe import _make_manager
 
     shape = f"{args.peers // 1000}k/{args.edges // 1_000_000}M"
+    # The zk backend rides the metric string only when it departs from
+    # the default, so the native series stays continuous across rounds
+    # recorded before the knob existed.
+    if args.zk_backend != "native":
+        shape = f"{shape}, zk={args.zk_backend}"
     manager = _make_manager(
-        scale_free(args.peers, args.edges, seed=7), args.prover
+        scale_free(args.peers, args.edges, seed=7),
+        args.prover,
+        args.zk_backend,
     )
     manager.generate_initial_attestations()
     manager.warm_prover()
@@ -208,6 +223,7 @@ def main(argv: list[str] | None = None) -> int:
             "workers": args.workers,
             "queue_depth": args.queue_depth,
             "prover": args.prover,
+            "zk_backend": args.zk_backend,
             "chaos": args.chaos,
             "interval_seconds": round(interval, 4),
             "smoke": bool(args.smoke),

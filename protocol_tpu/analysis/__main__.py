@@ -47,6 +47,16 @@ def main(argv: list[str] | None = None) -> int:
         help="which pass(es) to run (default: %(default)s)",
     )
     parser.add_argument(
+        "--zk",
+        action="store_true",
+        help=(
+            "extend the compile passes (comm/memory/determinism) to the "
+            "zk.graft proving kernels; their EC compiles take minutes, so "
+            "only the zk-graft CI job runs this by default (the jaxpr "
+            "pass always covers them — tracing is cheap)"
+        ),
+    )
+    parser.add_argument(
         "--fixture",
         default=None,
         help="run one seeded violation fixture instead of the real tree",
@@ -57,6 +67,24 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     _ensure_cpu_mesh()
+    if args.zk:
+        # The zk leg compiles EC kernels that take tens of seconds per
+        # (shape, kernel) pair on XLA:CPU; persist executables next to
+        # the keygen cache (same doctrine as tests/conftest.py) so
+        # repeat --zk runs pay compilation once per machine.
+        import pathlib
+
+        import jax
+
+        cache_root = os.environ.setdefault(
+            "PROTOCOL_TPU_CACHE",
+            str(pathlib.Path(__file__).resolve().parents[2]
+                / ".cache" / "protocol_tpu"),
+        )
+        jax_cache = pathlib.Path(cache_root) / "jax"
+        jax_cache.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(jax_cache))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     from .report import Report
 
     report = Report()
@@ -102,19 +130,19 @@ def main(argv: list[str] | None = None) -> int:
         if args.passes in ("all", "comm"):
             from .comm import run_comm_pass
 
-            findings, section = run_comm_pass()
+            findings, section = run_comm_pass(include_zk=args.zk)
             report.extend(findings)
             report.comm = section
         if args.passes in ("all", "memory"):
             from .memory import run_memory_pass
 
-            findings, section = run_memory_pass()
+            findings, section = run_memory_pass(include_zk=args.zk)
             report.extend(findings)
             report.memory = section
         if args.passes in ("all", "determinism"):
             from .determinism import run_determinism_pass
 
-            findings, section = run_determinism_pass()
+            findings, section = run_determinism_pass(include_zk=args.zk)
             report.extend(findings)
             report.determinism = section
 

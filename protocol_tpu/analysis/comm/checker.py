@@ -230,8 +230,14 @@ def _apply_waivers(findings: list[Finding]) -> tuple[list[Finding], list[dict], 
 
 def run_comm_pass(
     backends: list[str] | None = None,
+    *,
+    include_zk: bool = False,
 ) -> tuple[list[Finding], dict[str, Any]]:
     """Compile and check every registered backend (or the subset).
+
+    ``include_zk`` extends the default run to the zk.graft proving
+    kernels (``graftlint --zk`` / the zk-graft CI job) — their EC
+    compiles are too slow for the analyzer's default self-budget.
 
     Returns ``(findings, comm section)`` for ANALYSIS.json.
     """
@@ -239,9 +245,16 @@ def run_comm_pass(
     # their comm budgets next to their kernel budgets.
     from ...parallel import sharded  # noqa: F401  (declares sharded budgets)
     from ...trust.backend import registered_backends
+    from ..zk_lowering import register as _register_zk, zk_kernel_names
 
     registry = registered_backends()
-    targets = registry if backends is None else backends
+    zk_names = zk_kernel_names()
+    if include_zk or (backends and set(backends) & set(zk_names)):
+        _register_zk()
+    if backends is None:
+        targets = registry + zk_names if include_zk else registry
+    else:
+        targets = backends
     findings: list[Finding] = []
     section: dict[str, Any] = {"backends": {}}
 
@@ -310,9 +323,12 @@ def run_comm_pass(
             },
         }
 
-    # Budgets for names no longer in the registry rot silently.
+    # Budgets for names no longer in the registry rot silently.  The zk
+    # kernel names are live even when this run excludes them (their
+    # budgets register whenever the graft modules import in-process).
     if backends is None:
-        for name in sorted(set(COMM_INVARIANTS) - set(registry)):
+        known = set(registry) | set(zk_names)
+        for name in sorted(set(COMM_INVARIANTS) - known):
             findings.append(_finding(
                 "stale-comm-budget",
                 f"comm budget declared for {name!r} which is not a "

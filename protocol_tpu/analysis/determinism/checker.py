@@ -206,14 +206,31 @@ def _apply_waivers(findings: list[Finding]) -> tuple[list[Finding], list[dict], 
 
 def run_determinism_pass(
     backends: list[str] | None = None,
+    *,
+    include_zk: bool = False,
 ) -> tuple[list[Finding], dict[str, Any]]:
     """Run both static legs and return ``(findings, determinism
     section)`` for ANALYSIS.json.  ``backends`` narrows the HLO leg (and
-    skips the AST leg) — the pass-12 subset-run convention."""
+    skips the AST leg) — the pass-12 subset-run convention.
+    ``include_zk`` keeps the zk.graft proving kernels in the default
+    HLO leg; without it they are filtered out of COMM_BUILDERS (pass 1
+    registers their recipes in-process, but their EC compiles do not
+    fit the default self-budget)."""
     findings: list[Finding] = []
     section: dict[str, Any] = {"backends": {}}
 
-    targets = list(COMM_BUILDERS) if backends is None else backends
+    from ..zk_lowering import register as _register_zk, zk_kernel_names
+
+    zk_names = set(zk_kernel_names())
+    if include_zk or (backends and set(backends) & zk_names):
+        _register_zk()
+    if backends is None:
+        targets = [
+            name for name in COMM_BUILDERS
+            if include_zk or name not in zk_names
+        ]
+    else:
+        targets = backends
     for name in targets:
         if name not in COMM_BUILDERS:
             section["backends"][name] = {"status": "no-recipe"}

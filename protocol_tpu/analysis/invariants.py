@@ -457,9 +457,14 @@ def run_jaxpr_pass(
     from .. import parallel  # noqa: F401  (namespace anchor)
     from ..parallel import sharded  # noqa: F401  (declares sharded budgets)
     from ..trust.backend import registered_backends
+    from .zk_lowering import register as _register_zk
 
     registry = registered_backends()
-    targets = registry if backends is None else backends
+    # The zk.graft proving kernels ride the default gate here: tracing
+    # them is cheap (their expensive leg is compile, gated behind
+    # ``--zk`` in the later passes).
+    zk_names = _register_zk()
+    targets = registry + zk_names if backends is None else backends
     findings: list[Finding] = []
     meta: dict[str, dict[str, Any]] = {}
     graph = _synthetic_graph()
@@ -551,7 +556,8 @@ def run_jaxpr_pass(
 
     # Budgets declared for names no longer in the registry rot silently.
     if backends is None:
-        for name in sorted(set(KERNEL_INVARIANTS) - set(registry)):
+        known = set(registry) | set(zk_names)
+        for name in sorted(set(KERNEL_INVARIANTS) - known):
             findings.append(
                 Finding(
                     pass_name="jaxpr",

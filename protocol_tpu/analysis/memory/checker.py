@@ -236,18 +236,29 @@ def _apply_waivers(findings: list[Finding]) -> tuple[list[Finding], list[dict], 
 
 def run_memory_pass(
     backends: list[str] | None = None,
+    *,
+    include_zk: bool = False,
 ) -> tuple[list[Finding], dict[str, Any]]:
     """Compile (or reuse pass 8's executables for) every registered
     backend and check MEM_INVARIANTS, then run the pass-12 AST rules
-    over the long-lived node trees.  Returns ``(findings, memory
-    section)`` for ANALYSIS.json."""
+    over the long-lived node trees.  ``include_zk`` extends the run to
+    the zk.graft proving kernels (``graftlint --zk``), whose EC
+    compiles are too slow for the default self-budget.  Returns
+    ``(findings, memory section)`` for ANALYSIS.json."""
     # Importing the registry imports the kernel modules, which declare
     # their memory budgets next to their kernel/comm budgets.
     from ...parallel import sharded  # noqa: F401  (declares sharded budgets)
     from ...trust.backend import registered_backends
+    from ..zk_lowering import register as _register_zk, zk_kernel_names
 
     registry = registered_backends()
-    targets = registry if backends is None else backends
+    zk_names = zk_kernel_names()
+    if include_zk or (backends and set(backends) & set(zk_names)):
+        _register_zk()
+    if backends is None:
+        targets = registry + zk_names if include_zk else registry
+    else:
+        targets = backends
     findings: list[Finding] = []
     section: dict[str, Any] = {"backends": {}}
 
@@ -317,9 +328,12 @@ def run_memory_pass(
             },
         }
 
-    # Budgets for names no longer in the registry rot silently.
+    # Budgets for names no longer in the registry rot silently.  The zk
+    # kernel names are live even when this run excludes them (their
+    # budgets register whenever the graft modules import in-process).
     if backends is None:
-        for name in sorted(set(MEM_INVARIANTS) - set(registry)):
+        known = set(registry) | set(zk_names)
+        for name in sorted(set(MEM_INVARIANTS) - known):
             findings.append(_finding(
                 "stale-mem-budget",
                 f"memory budget declared for {name!r} which is not a "

@@ -1,0 +1,211 @@
+"""Analyzer recipes for the zk.graft proving kernels (PERF.md §22).
+
+The graft backend's five jit kernels carry KERNEL/COMM/MEM budget
+declarations next to their definitions, exactly like the trust rungs —
+this module supplies the matching **recipes** so the declarations are
+never vacuous:
+
+- trace recipes (pass 1): ``make_jaxpr`` of each kernel at a fixed
+  small shape — cheap enough to run in the default gate alongside the
+  trust backends;
+- lowering recipes (passes 8/12/13): real ``lower().compile()`` of the
+  same entry points at two scales, feeding the comm walk, the
+  buffer-assignment memory check, and the double-compile drift check.
+
+The lowering leg is **opt-in** (``graftlint --zk``, the zk-graft CI
+job, and the slow tests): an EC group add inlines 16 Montgomery
+multiplies and XLA:CPU pays tens of seconds per compile, which does
+not fit the analyzer's 120 s self-budget.  The MSM fold/carry/bucket
+recipes therefore also use a smaller lane count than the field
+kernels — the carry scan's compile cost grows with ``log2(n/BLOCK)``
+inlined group adds, while the budget coefficients are per-lane and
+scale-checked all the same.
+
+Proving-plane kernels are single-device by construction, so every comm
+budget is zero collectives and the interesting checks are the memory
+footprint, the scatter/gather discipline, and pass 13's determinism
+wall over the compiled modules (the bucket scatters must stay
+``unique_indices=true`` or two proves could legally disagree).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .comm.lowering import COMM_BUILDERS, CommCase, _mem_stats
+from .invariants import TRACE_BUILDERS, TraceCase
+from .jaxpr_walk import PSUM_PRIMITIVES, collect_primitives
+
+#: Lane count used by the MSM fold/carry/bucket recipes at each comm
+#: scale, derived from the scale's N: small enough that the carry
+#: scan's log2(n/BLOCK) inlined group adds compile in seconds, large
+#: enough that both scales exercise >1 carry round.
+_MSM_LANES_DIVISOR = 8
+
+
+def _jaxpr_psums(jaxpr: Any) -> int:
+    return len(collect_primitives(jaxpr, PSUM_PRIMITIVES))
+
+
+def _zk_modules():
+    """The kernel modules (imported on demand; importing declares the
+    KERNEL/COMM/MEM budgets)."""
+    from ..zk.graft import field, ntt, pippenger
+
+    return field, ntt, pippenger
+
+
+# -- shared entry-point builders (trace and lowering reuse these) -----------
+
+
+def _mulmod_entry(n: int):
+    import jax.numpy as jnp
+
+    field, _, _ = _zk_modules()
+    a = jnp.zeros((n, field.NLIMBS), jnp.uint32)
+    return field.mulmod_fr, (a, a)
+
+
+def _ntt_stage_entry(n: int):
+    import jax.numpy as jnp
+
+    field, ntt, _ = _zk_modules()
+    L = 64  # a mid NTT stage: blocks x L butterflies
+    x = jnp.zeros((max(n // L, 1), L, field.NLIMBS), jnp.uint32)
+    tw = jnp.zeros((L // 2, field.NLIMBS), jnp.uint32)
+    return ntt._stage_fn(), (x, tw)
+
+
+def _msm_window_entry(n: int):
+    import jax.numpy as jnp
+
+    field, _, pip = _zk_modules()
+    digits = jnp.zeros((pip.WINDOWS, n), jnp.int32)
+    points = jnp.zeros((n, 3, field.NLIMBS), jnp.uint32)
+    return pip._kernels()["window"], (digits, points)
+
+
+def _msm_scan_entry(n: int):
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.segments import block_boundary_flags
+
+    field, _, pip = _zk_modules()
+    k = pip._kernels()
+    blk = min(pip.BLOCK, n)
+    nb = n // blk
+    ptsb = jnp.zeros((pip.WINDOWS, nb, blk, 3, field.NLIMBS), jnp.uint32)
+    dsb = jnp.zeros((pip.WINDOWS, nb, blk), jnp.int32)
+
+    @jax.jit
+    def scan(ptsb, dsb):
+        local, tails = k["fold"](ptsb, dsb)
+        return local, k["carry"](tails, block_boundary_flags(dsb))
+
+    return scan, (ptsb, dsb)
+
+
+def _msm_bucket_entry(n: int):
+    import jax.numpy as jnp
+
+    field, _, pip = _zk_modules()
+    blk = min(pip.BLOCK, n)
+    nb = n // blk
+    local = jnp.zeros((pip.WINDOWS, n, 3, field.NLIMBS), jnp.uint32)
+    ds = jnp.zeros((pip.WINDOWS, n), jnp.int32)
+    dsb = jnp.zeros((pip.WINDOWS, nb, blk), jnp.int32)
+    c = jnp.zeros((pip.WINDOWS, nb, 3, field.NLIMBS), jnp.uint32)
+    return pip._kernels()["bucket"], (local, ds, dsb, c)
+
+
+#: backend name -> (entry builder, arg names, lane count from scale N).
+_ZK_ENTRIES: dict[str, tuple[Any, tuple[str, ...], Any]] = {
+    "zk-graft-mulmod": (_mulmod_entry, ("a", "b"), lambda n: n),
+    "zk-graft-ntt-stage": (_ntt_stage_entry, ("x", "tw"), lambda n: n),
+    "zk-graft-msm-window": (_msm_window_entry, ("digits", "points"), lambda n: n),
+    "zk-graft-msm-scan": (
+        _msm_scan_entry,
+        ("ptsb", "dsb"),
+        lambda n: n // _MSM_LANES_DIVISOR,
+    ),
+    "zk-graft-msm-bucket": (
+        _msm_bucket_entry,
+        ("local", "ds", "dsb", "c"),
+        lambda n: n // _MSM_LANES_DIVISOR,
+    ),
+}
+
+#: Trace shape for pass 1 (small: tracing cost rides the default gate).
+_TRACE_N = 1024
+
+
+def _make_trace_builder(name: str):
+    entry_builder, _, lanes_of = _ZK_ENTRIES[name]
+
+    def build(_graph) -> TraceCase:
+        import jax
+
+        fn, args = entry_builder(lanes_of(_TRACE_N))
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        return TraceCase(name, jaxpr, dims={"n": lanes_of(_TRACE_N)})
+
+    return build
+
+
+def _make_comm_builder(name: str):
+    entry_builder, arg_names, lanes_of = _ZK_ENTRIES[name]
+
+    def build(n: int, e: int) -> CommCase:
+        import jax
+
+        lanes = lanes_of(n)
+        fn, args = entry_builder(lanes)
+        compiled = fn.lower(*args).compile()
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        return CommCase(
+            backend=name,
+            dims={"n": lanes, "n_shards": 1},
+            module_text=compiled.as_text(),
+            arg_names=arg_names,
+            jaxpr_psums=_jaxpr_psums(jaxpr),
+            mem=_mem_stats(compiled),
+        )
+
+    return build
+
+
+def zk_kernel_names() -> list[str]:
+    """The registry slice this module covers (mirrors
+    ``zk.graft.registered_zk_kernels`` — asserted in tests)."""
+    from ..zk.graft import registered_zk_kernels
+
+    return registered_zk_kernels()
+
+
+def ensure_budgets() -> list[str]:
+    """Import the kernel modules so their KERNEL/COMM/MEM budget
+    declarations are registered; returns the kernel names."""
+    _zk_modules()
+    return zk_kernel_names()
+
+
+_REGISTERED = False
+
+
+def register() -> list[str]:
+    """Merge the zk recipes into the shared TRACE/COMM builder tables
+    (idempotent) and return the kernel names.  Pass 1 calls this in the
+    default gate (traces are cheap); the compile passes call it only
+    under ``--zk``."""
+    global _REGISTERED
+    names = ensure_budgets()
+    if not _REGISTERED:
+        for name in names:
+            TRACE_BUILDERS[name] = _make_trace_builder(name)
+            COMM_BUILDERS[name] = (_make_comm_builder(name), True)
+        _REGISTERED = True
+    return names
+
+
+__all__ = ["ensure_budgets", "register", "zk_kernel_names"]

@@ -86,6 +86,11 @@ class ManagerConfig:
     #: it the PLONK prover generates a fresh random setup at boot —
     #: sound only for verifiers who trust this node's keygen.
     srs_path: str | None = None
+    #: Proving-kernel backend for the SNARK inner loops
+    #: (zk/graft ladder: "native" — ctypes IFMA runtime with Python
+    #: fallback — or "graft" — the jit multi-limb MSM/NTT).  Pure
+    #: execution selection: proofs are byte-identical either way.
+    zk_backend: str = "native"
     #: Seed each epoch's convergence from the previous epoch's fixed
     #: point (renormalized over joined/departed peers) — the fixed
     #: point is start-independent, so this only shortens the path
@@ -515,6 +520,7 @@ class Manager:
             srs_path=cfg.srs_path,
             check_circuit=cfg.check_circuit,
             graph_fingerprint=fingerprint,
+            zk_backend=cfg.zk_backend,
         )
 
     def install_proof(self, epoch_number: int, pub_ins, proof_bytes: bytes) -> None:

@@ -1,0 +1,70 @@
+"""Sorted-segment helpers shared across the jit backends.
+
+The trust plane's sorted SpMV (:mod:`.sparse`) and the proving plane's
+Pippenger bucket accumulation (:mod:`..zk.graft.pippenger`) reduce the same
+shape of problem: values carrying sorted integer ids, folded per id.
+These helpers are the id-side machinery — run-end masks and the
+segmented block-carry scan — kept dtype/monoid-agnostic so the EC
+group fold and a float rowsum can ride the identical index logic.
+
+All functions are shape-polymorphic jax and safe to call inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+def run_end_mask(ids: jnp.ndarray) -> jnp.ndarray:
+    """(..., n) sorted ids -> bool mask marking the LAST lane of every
+    run of equal ids.  The final lane is always a run end (the wrapped
+    ``roll`` comparison would otherwise drop it when all ids match)."""
+    n = ids.shape[-1]
+    nxt = jnp.roll(ids, -1, axis=-1)
+    last = jnp.arange(n) == n - 1
+    return (ids != nxt) | last
+
+
+def block_boundary_flags(ids_blocked: jnp.ndarray) -> jnp.ndarray:
+    """(..., nblocks, B) sorted ids -> (..., nblocks) bool: True when
+    the block contains an internal run boundary.  Sortedness makes the
+    test O(1) per block: first == last implies the whole block is one
+    run."""
+    return ids_blocked[..., 0] != ids_blocked[..., -1]
+
+
+def segmented_carry_scan(
+    values,
+    flags: jnp.ndarray,
+    combine: Callable,
+    axis: int = -1,
+):
+    """Segmented inclusive Hillis–Steele scan over ``axis``.
+
+    Computes ``C[b] = values[b] if flags[b] else combine(C[b-1],
+    values[b])`` in ``log2(n)`` rounds — the cross-block carry pass of
+    a two-level segmented fold (block-local fold first, then this over
+    the block tails, exactly the hierarchical shape ``rowsum_sorted``
+    uses for its compensated cumsum).  ``combine(left, right)`` must be
+    associative; ``values`` may have trailing payload dims beyond
+    ``flags`` (they are broadcast on the mask).
+    """
+    axis = axis % flags.ndim
+    n = flags.shape[axis]
+    lane = jnp.arange(n).reshape((n,) + (1,) * (flags.ndim - 1 - axis))
+    extra = values.ndim - flags.ndim
+    s = 1
+    while s < n:
+        v_shift = jnp.roll(values, s, axis=axis)
+        f_shift = jnp.roll(flags, s, axis=axis)
+        active = (lane >= s) & ~flags
+        values = jnp.where(
+            active.reshape(active.shape + (1,) * extra),
+            combine(v_shift, values),
+            values,
+        )
+        flags = flags | ((lane >= s) & f_shift)
+        s <<= 1
+    return values

@@ -81,6 +81,12 @@ class ProofJob:
     graph_fingerprint: int = 0
     #: Chaos hook (tests/bench): CRASH_MARKER or crash_once_marker().
     chaos: str | None = None
+    #: Proving-kernel backend (``zk.graft.VALID_BACKENDS``): ``native``
+    #: (ctypes IFMA runtime) or ``graft`` (jit MSM/NTT).  Execution
+    #: selection only — both produce byte-identical proofs, so it is
+    #: excluded from :func:`job_seed` like the other bookkeeping
+    #: fields, and pooled proofs survive a backend switch unchanged.
+    zk_backend: str = "native"
     #: Lineage IDs (obs/lineage.py) whose end-to-end freshness this
     #: epoch's proof completes — flat ints across the spawn boundary,
     #: echoed back on the :class:`ProofResult`.  ``()`` on the
@@ -218,6 +224,8 @@ def prove_job(job: ProofJob, *, verify: bool = True) -> ProofResult:
     ops = [list(row) for row in job.ops]
     prover = prover_for(job.params, job.prover, job.srs_path)
 
+    from ..zk.graft import use_zk_backend
+
     t0 = time.perf_counter()
     with TRACER.span("prove", epoch=job.epoch, pooled=True) as root:
         with TRACER.span("power_iterate"):
@@ -237,7 +245,7 @@ def prove_job(job: ProofJob, *, verify: bool = True) -> ProofResult:
                     initial_score=initial_score,
                     scale=scale,
                 )
-        with TRACER.span("snark"):
+        with TRACER.span("snark"), use_zk_backend(job.zk_backend):
             proof_bytes = prover.prove(pub_ins, witness, seed=job_seed(job))
     if verify:
         assert prover.verify(pub_ins, proof_bytes), (

@@ -1,0 +1,199 @@
+"""zk.graft — the jittable accelerator backend for the prover's inner
+loops (PERF.md §22).
+
+The native attribution named the enemy (msm 63.5% / ntt 7.1% of
+whole-core prove time, PERF.md §16); this package is the same move the
+trust kernels made in PR 2 — a jit'd, budget-pinned execution path
+cross-checked bit-for-bit against the native one — applied to the
+proving plane: a batched u32-limb Montgomery field layer
+(:mod:`.field`), an iterative radix-2 NTT (:mod:`.ntt`), and a
+vectorized Pippenger MSM whose bucket accumulation rides the repo's
+sorted-segment machinery (:mod:`.pippenger`).
+
+This module itself is **jax-free**: prover worker processes import it
+for the dispatch knob and phase table, and only a worker that actually
+selects ``zk_backend="graft"`` pays the jax import (the kernel modules
+are loaded lazily on first use).  The math is exact — MSM and NTT
+results are group elements / field vectors, not floats — so the graft
+and native backends are byte-identical by construction and the parity
+suite (tests/test_zk_graft.py) is the acceptance oracle.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+#: The registered jit kernels of the graft backend.  These names are
+#: unioned into the graftlint registry walk (passes 1/8/12) and carry
+#: KERNEL/COMM/MEM budget declarations next to the kernels they pin —
+#: the same undeclared-budget-is-an-error policy as the trust backends.
+ZK_KERNELS = (
+    "zk-graft-mulmod",
+    "zk-graft-ntt-stage",
+    "zk-graft-msm-window",
+    "zk-graft-msm-scan",
+    "zk-graft-msm-bucket",
+)
+
+
+def registered_zk_kernels() -> list[str]:
+    """Kernel names the analyzers must find budgets + recipes for."""
+    return list(ZK_KERNELS)
+
+
+# ---------------------------------------------------------------------------
+# Backend knob
+# ---------------------------------------------------------------------------
+
+#: Process-wide default; per-thread overrides via use_zk_backend (the
+#: proving plane's worker threads select per ProofJob).
+_DEFAULT_BACKEND = "native"
+_local = threading.local()
+
+VALID_BACKENDS = ("native", "graft")
+
+
+def zk_backend() -> str:
+    """The active proving-kernel backend: ``native`` (default — the
+    ctypes IFMA runtime with pure-python fallback) or ``graft``."""
+    return getattr(_local, "backend", _DEFAULT_BACKEND)
+
+
+def set_zk_backend(name: str) -> None:
+    if name not in VALID_BACKENDS:
+        raise ValueError(
+            f"unknown zk_backend {name!r}; expected one of {VALID_BACKENDS}"
+        )
+    _local.backend = name
+
+
+@contextlib.contextmanager
+def use_zk_backend(name: str):
+    """Scoped backend selection (what ``prove_job`` wraps the prove in,
+    so pooled workers never leak a knob across jobs)."""
+    if name not in VALID_BACKENDS:
+        raise ValueError(
+            f"unknown zk_backend {name!r}; expected one of {VALID_BACKENDS}"
+        )
+    prev = getattr(_local, "backend", None)
+    _local.backend = name
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _local.backend
+        else:
+            _local.backend = prev
+
+
+# ---------------------------------------------------------------------------
+# Phase timers — same table shape as zk.native.phase_stats(), so
+# plonk._ProveAttribution folds both engines into the same
+# snark -> {msm, ntt, ...} span children (attribution survives a
+# backend switch; tools/prover_pipe.py asserts it).
+# ---------------------------------------------------------------------------
+
+PHASES = ("msm", "ntt", "gate_eval", "field_ops", "srs")
+
+_phase_lock = threading.Lock()
+_phase_table: dict[str, dict[str, float]] = {
+    p: {"calls": 0, "seconds": 0.0} for p in PHASES
+}
+
+
+def phase_stats() -> dict[str, dict[str, float]]:
+    """Snapshot of the graft backend's per-phase host wall time (the
+    kernels sync results back to host, so wall time includes device
+    work — the analog of the native runtime's relaxed-atomic timers)."""
+    with _phase_lock:
+        return {p: dict(row) for p, row in _phase_table.items()}
+
+
+def reset_phase_stats() -> None:
+    with _phase_lock:
+        for row in _phase_table.values():
+            row["calls"] = 0
+            row["seconds"] = 0.0
+
+
+def _bump_phase(phase: str, seconds: float) -> None:
+    with _phase_lock:
+        row = _phase_table[phase]
+        row["calls"] += 1
+        row["seconds"] += seconds
+
+
+def phase_delta(before, after):
+    """Per-phase difference of two snapshots (mirrors
+    ``zk.native.phase_delta`` so attribution code treats both tables
+    uniformly)."""
+    out = {}
+    for p in PHASES:
+        b = before.get(p, {"calls": 0, "seconds": 0.0})
+        a = after.get(p, {"calls": 0, "seconds": 0.0})
+        out[p] = {
+            "calls": a["calls"] - b["calls"],
+            "seconds": a["seconds"] - b["seconds"],
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Lazy kernel entry points (jax imported on first graft call only)
+# ---------------------------------------------------------------------------
+
+
+def msm(scalars, points):
+    """Graft MSM over affine G1 points; exact, identity-aware."""
+    from . import pippenger as _msm
+
+    return _msm.msm(scalars, points)
+
+
+def msm_limbs(arr, cache):
+    """Graft MSM over a prepared :class:`~.pippenger.PointCache` with (n, 4)
+    u64 canonical scalar limbs (the ``Setup.commit_limbs`` fast path)."""
+    from . import pippenger as _msm
+
+    return _msm.msm_limbs(arr, cache)
+
+
+def msm_limbs_batch(arrs, cache):
+    from . import pippenger as _msm
+
+    return _msm.msm_limbs_batch(arrs, cache)
+
+
+def point_cache(points):
+    """Build (and the caller caches) the device-side point
+    preprocessing — the once-per-prove bucket setup."""
+    from . import pippenger as _msm
+
+    return _msm.PointCache.build(points)
+
+
+def ntt_limbs(arr, root, inverse):
+    """In-place-shaped NTT over (n, 4) u64 canonical Fr limbs."""
+    from . import ntt as _ntt
+
+    return _ntt.ntt_limbs(arr, root, inverse)
+
+
+__all__ = [
+    "PHASES",
+    "VALID_BACKENDS",
+    "ZK_KERNELS",
+    "msm",
+    "msm_limbs",
+    "msm_limbs_batch",
+    "ntt_limbs",
+    "phase_delta",
+    "phase_stats",
+    "point_cache",
+    "registered_zk_kernels",
+    "reset_phase_stats",
+    "set_zk_backend",
+    "use_zk_backend",
+    "zk_backend",
+]

@@ -1,0 +1,316 @@
+"""Batched multi-limb modular arithmetic on u32 lanes (PERF.md §22).
+
+The layout mirrors the native runtime's radix-52 lazy-reduction story
+(native/zk_ifma.cpp) translated to what XLA vectorizes well without
+64-bit integers: a 256-bit element is sixteen 16-bit limbs in a
+``(..., 16)`` uint32 array, so every limb product fits a u32 exactly
+(``(2^16-1)^2 < 2^32``) and column sums of one schoolbook pass stay
+under ``2^21`` — carries are deferred across the whole vectorized lane
+and resolved in one propagation sweep per product, the same
+accumulate-then-normalize discipline the IFMA kernel (and the
+wrong-field chips over 68-bit RNS limbs, zk/rns.py) use.
+
+Reduction is word-by-word Montgomery (REDC): products live in the
+Montgomery domain ``â = a·2^256 mod p`` and one multiplication is a
+512-bit schoolbook product + a low-half multiply by ``-p^{-1} mod
+2^256`` + one fold — ~600 vector ops total, exact by construction.
+Exactness is the contract: these kernels feed bit-identity sinks
+(proof bytes).  The one float appearance — column sums evaluated as an
+f32 one-hot matmul — is exact by range analysis (every addend < 2^16,
+every sum < 2^21 < 2^24), and the parity suite (tests/test_zk_graft.py)
+pins every operation against Python ints anyway.
+
+Import note: this module imports jax; only code paths that actually
+selected ``zk_backend="graft"`` (or the analyzers) load it.
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...analysis.budget import (
+    CommBudget,
+    KernelBudget,
+    MemBudget,
+    declare,
+    declare_comm,
+    declare_mem,
+)
+from ...crypto.field import MODULUS as FR_MODULUS
+from ..rns import FQ_MODULUS
+
+NLIMBS = 16
+LIMB_BITS = 16
+MASK = (1 << LIMB_BITS) - 1
+RADIX = 1 << (NLIMBS * LIMB_BITS)  # 2^256, the Montgomery R
+
+
+def _int_to_limbs_np(v: int, n: int = NLIMBS) -> np.ndarray:
+    return np.array([(v >> (LIMB_BITS * i)) & MASK for i in range(n)], dtype=np.uint32)
+
+
+def ints_to_limbs(values) -> np.ndarray:
+    """Python ints -> (n, 16) u32 little-endian 16-bit limbs."""
+    buf = b"".join(v.to_bytes(32, "little") for v in values)
+    return np.frombuffer(buf, dtype=np.uint16).reshape(-1, NLIMBS).astype(np.uint32)
+
+
+def limbs_to_ints(arr: np.ndarray) -> list[int]:
+    buf = np.ascontiguousarray(arr.astype(np.uint16)).tobytes()
+    return [int.from_bytes(buf[i : i + 32], "little") for i in range(0, len(buf), 32)]
+
+
+def u64_to_limbs(arr: np.ndarray) -> np.ndarray:
+    """(n, 4) u64 canonical limbs (utils/limbs.py layout) -> (n, 16) u32."""
+    a = np.ascontiguousarray(arr, dtype=np.uint64)
+    return a.view(np.uint16).reshape(a.shape[0], NLIMBS).astype(np.uint32)
+
+
+def limbs_to_u64(arr: np.ndarray) -> np.ndarray:
+    a = np.ascontiguousarray(np.asarray(arr).astype(np.uint16))
+    return a.view(np.uint64).reshape(a.shape[0], 4).copy()
+
+
+def _gp_prefix(g: jax.Array, p: jax.Array) -> jax.Array:
+    """Inclusive generate/propagate prefix along the limb axis — a
+    hand-rolled Kogge–Stone (log2(K) rounds of contiguous pad-shifts;
+    ``lax.associative_scan`` lowers to strided odd/even slicing that
+    XLA:CPU executes ~3x slower).  Returns the accumulated generate
+    bit: ``gacc[i]`` is the carry out of position ``i``."""
+    k = g.shape[-1]
+    shift = [(0, 0)] * (g.ndim - 1)
+    d = 1
+    while d < k:
+        gs = jnp.pad(g[..., :-d], shift + [(d, 0)])
+        ps = jnp.pad(p[..., :-d], shift + [(d, 0)])
+        g = g | (p & gs)
+        p = p & ps
+        d <<= 1
+    return g
+
+
+def _carry_sweep(cols: jax.Array) -> jax.Array:
+    """Resolve deferred column carries: (..., K) u32 columns (each
+    < 2^21) -> (..., K) clean 16-bit limbs.
+
+    Two steps, both lane-parallel: (1) split every column hi/lo and add
+    the multi-bit high parts one position up — after that each position
+    holds ``s < 2^16 + 32`` so at most a single-bit carry remains; (2)
+    resolve the single-bit chain with a log-depth generate/propagate
+    prefix (``lax.associative_scan``) instead of a 32-step ripple.  A
+    naive unrolled ripple made one EC add (16 inlined muls) cost 114 s
+    of XLA time; a ``lax.scan`` ripple compiled fast but its while-loop
+    blocked fusion and tripled runtime.  The prefix form is both small
+    to compile and fully fusable."""
+    hi = cols >> LIMB_BITS
+    lo = cols & MASK
+    shift = [(0, 0)] * (cols.ndim - 1) + [(1, 0)]
+    s = lo + jnp.pad(hi[..., :-1], shift)
+    g = (s >> LIMB_BITS).astype(bool)
+    p = (s & MASK) == MASK
+    cin = jnp.pad(_gp_prefix(g, p)[..., :-1], shift).astype(jnp.uint32)
+    return (s + cin) & MASK
+
+
+def _column_matrix(out_limbs: int) -> np.ndarray:
+    """One-hot column-sum matrix: partial product (i, j) (lo half) and
+    its carry half land in columns i+j and i+j+1.  The 512-bit
+    schoolbook column sums then become ONE ``(N, 512) @ (512, K)``
+    dot_general — the MXU-shaped formulation on a real chip, and the
+    BLAS path under the CPU analyzer mesh (measured 28x over the
+    elementwise pad/add chain XLA:CPU refuses to fuse, PERF.md §22)."""
+    oh = np.zeros((2 * NLIMBS * NLIMBS, 2 * NLIMBS), np.float32)
+    for i in range(NLIMBS):
+        for j in range(NLIMBS):
+            oh[i * NLIMBS + j, i + j] = 1.0
+            oh[NLIMBS * NLIMBS + i * NLIMBS + j, i + j + 1] = 1.0
+    return np.ascontiguousarray(oh[:, :out_limbs])
+
+
+_OH_FULL = _column_matrix(2 * NLIMBS)
+_OH_LOW = _column_matrix(NLIMBS)
+
+
+def _mul_cols(a: jax.Array, b: jax.Array, oh: np.ndarray) -> jax.Array:
+    """Deferred-carry schoolbook columns via the one-hot matmul.
+
+    Exactness: every lo/hi half is < 2^16 and each column receives at
+    most 32 of them, so the f32 accumulation stays below 2^21 — inside
+    the 24-bit mantissa, bit-exact by construction (the same integers-
+    in-float argument the paper's TPU path makes for i32 SpMV on the
+    MXU).  No f64 anywhere; the kernel budget pins that."""
+    a, b = jnp.broadcast_arrays(a, b)
+    shape = a.shape[:-1]
+    n2 = NLIMBS * NLIMBS
+    af = a.reshape(-1, NLIMBS)
+    bf = b.reshape(-1, NLIMBS)
+    prod = (af[:, :, None] * bf[:, None, :]).reshape(-1, n2)
+    lohi = jnp.concatenate(
+        [(prod & MASK).astype(jnp.float32), (prod >> LIMB_BITS).astype(jnp.float32)],
+        axis=1,
+    )
+    cols = (lohi @ jnp.asarray(oh)).astype(jnp.uint32)
+    return cols.reshape(shape + (oh.shape[1],))
+
+
+def mul_full(a: jax.Array, b: jax.Array) -> jax.Array:
+    """(..., 16) x (..., 16) -> (..., 32) exact 512-bit product."""
+    return _carry_sweep(_mul_cols(a, b, _OH_FULL))
+
+
+def mul_low(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Low 256 bits of the product (mod 2^256) — the REDC m-step."""
+    return _carry_sweep(_mul_cols(a, b, _OH_LOW))
+
+
+def _add_limbs(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Limbwise add + one carry sweep (values < 2^17 per column)."""
+    return _carry_sweep(a + b)
+
+
+def _sub_limbs(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """a - b with a borrow chain; returns (diff limbs, borrow flag).
+    Same generate/propagate prefix as :func:`_carry_sweep`: limb i
+    generates a borrow when ``a_i < b_i`` and propagates when equal."""
+    a, b = jnp.broadcast_arrays(a, b)
+    t = a + jnp.uint32(1 << LIMB_BITS) - b
+    g = t < jnp.uint32(1 << LIMB_BITS)
+    p = t == jnp.uint32(1 << LIMB_BITS)
+    gacc = _gp_prefix(g, p)
+    shift = [(0, 0)] * (a.ndim - 1) + [(1, 0)]
+    bin_ = jnp.pad(gacc[..., :-1], shift).astype(jnp.uint32)
+    return (t - bin_) & MASK, gacc[..., -1].astype(jnp.uint32)
+
+
+def is_zero(a: jax.Array) -> jax.Array:
+    """(..., 16) -> (...,) bool; Montgomery zero is limbwise zero."""
+    return jnp.all(a == 0, axis=-1)
+
+
+class Field:
+    """One prime field's constants + vector kernels (Fr and Fq below).
+
+    Elements live in the Montgomery domain (``to_mont``/``from_mont``
+    at the boundaries); all ops keep canonical ``< p`` limbs so
+    cross-backend parity is a straight byte comparison.
+    """
+
+    def __init__(self, name: str, p: int):
+        self.name = name
+        self.p = p
+        self.p_np = _int_to_limbs_np(p)
+        # -p^{-1} mod 2^256: the REDC multiplier.
+        self.nprime_np = _int_to_limbs_np((-pow(p, -1, RADIX)) % RADIX)
+        self.r = RADIX % p  # Montgomery form of 1
+        self.r2 = (RADIX * RADIX) % p
+        self.r_np = _int_to_limbs_np(self.r)
+        self.r2_np = _int_to_limbs_np(self.r2)
+
+    # -- traced building blocks (composable inside larger kernels) ----
+
+    def redc(self, t: jax.Array) -> jax.Array:
+        """Montgomery fold: (..., 32) carried limbs T < p·2^256 ->
+        (..., 16) with value T·2^-256 mod p, canonical (< p)."""
+        m = mul_low(t[..., :NLIMBS], jnp.asarray(self.nprime_np))
+        mp = mul_full(m, jnp.asarray(self.p_np))
+        s = _add_limbs(t, mp)  # low 16 limbs cancel to zero by design
+        return self.cond_sub_p(s[..., NLIMBS:])
+
+    def mont_mul(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        return self.redc(mul_full(a, b))
+
+    def cond_sub_p(self, x: jax.Array) -> jax.Array:
+        d, borrow = _sub_limbs(x, jnp.asarray(self.p_np))
+        return jnp.where((borrow != 0)[..., None], x, d)
+
+    def add(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        # a + b < 2p < 2^256: the carry out of limb 15 is always 0.
+        return self.cond_sub_p(_add_limbs(a, b))
+
+    def sub(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        d, borrow = _sub_limbs(a, b)
+        wrapped = _add_limbs(d, jnp.asarray(self.p_np))
+        return jnp.where((borrow != 0)[..., None], wrapped, d)
+
+    def to_mont(self, a: jax.Array) -> jax.Array:
+        return self.mont_mul(a, jnp.asarray(self.r2_np))
+
+    def from_mont(self, a: jax.Array) -> jax.Array:
+        pad = [(0, 0)] * (a.ndim - 1) + [(0, NLIMBS)]
+        return self.redc(jnp.pad(a, pad))
+
+    # -- host-side exact helpers (conversion boundaries) --------------
+
+    def to_mont_int(self, v: int) -> int:
+        return (v * RADIX) % self.p
+
+    def from_mont_int(self, v: int) -> int:
+        return (v * pow(RADIX, -1, self.p)) % self.p
+
+
+FR = Field("fr", FR_MODULUS)
+FQ = Field("fq", FQ_MODULUS)
+
+_FIELDS = {"fr": FR, "fq": FQ}
+
+
+#: Jitted standalone entry for the registered ``zk-graft-mulmod``
+#: kernel: one batched Montgomery multiply in Fr (the NTT/quotient
+#: workhorse).  Larger kernels (NTT stages, EC combine rounds) inline
+#: the same traced building blocks.
+@jax.jit
+def mulmod_fr(a: jax.Array, b: jax.Array) -> jax.Array:
+    return FR.mont_mul(a, b)
+
+
+@jax.jit
+def mulmod_fq(a: jax.Array, b: jax.Array) -> jax.Array:
+    return FQ.mont_mul(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Pinned kernel invariants (graftlint passes 1/8/12) — the mulmod
+# kernel is pure lane arithmetic: no gather, no scatter, no f64, no
+# host callback, no collectives.  Memory coefficients measured from
+# the compiled buffer assignment at the analyzer's two pinned scales
+# (n=1024/2048): resident = two (n,16) u32 operands = 128 B/row;
+# transient = the deferred-carry column accumulators + the unaliased
+# (n,16) output — the per-i partial-product stream fuses, but the
+# 32-column u32 accumulator and the REDC fold each hold a few
+# (n,32)-shaped lives (measured 1280 B/row at both scales, slack
+# under one extra (n,32) buffer).
+# ---------------------------------------------------------------------------
+
+declare(
+    KernelBudget(
+        backend="zk-graft-mulmod",
+        max_random_gathers=0,
+        max_scatters=0,
+        require_primitives=("dot_general",),
+        notes="batched Montgomery mul: pure lane arithmetic (one-hot "
+        "column matmuls), carries deferred to one sweep per product",
+    )
+)
+
+declare_comm(
+    CommBudget(
+        backend="zk-graft-mulmod",
+        notes="single-device field kernel: no wire, no host traffic",
+    )
+)
+
+declare_mem(
+    MemBudget(
+        backend="zk-graft-mulmod",
+        resident_n=128.0,  # two (n,16) u32 operands
+        resident_const=4096.0,
+        transient_n=2048.0,  # carry columns + REDC fold + output
+        transient_const=16384.0,
+        notes="schoolbook columns live as (n,32) u32 accumulators "
+        "between the deferred-carry sweeps",
+    )
+)

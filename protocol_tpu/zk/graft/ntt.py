@@ -1,0 +1,198 @@
+"""Iterative radix-2 NTT over the u32-limb representation (PERF.md §22).
+
+Decimation-in-time Cooley–Tukey: one host-side bit-reverse permutation,
+then ``log2(n)`` jitted butterfly stages.  Each stage is a single
+batched Montgomery multiply of the odd half against the stage's twiddle
+vector plus one lazy add/sub pair — the kernel the ``zk-graft-ntt-stage``
+budgets pin.  Twiddle vectors are computed once per ``(n, root)`` pair
+with exact Python ints, converted to the Montgomery domain, and cached
+for the life of the process (a k=14 prove replays the same four plans
+dozens of times).
+
+The transform is bit-identical to ``plonk._py_ntt`` / ``native zk_ntt``
+by construction: every butterfly is exact modular arithmetic, and the
+parity suite round-trips ``intt(ntt(x)) == x`` against both.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ...crypto.field import MODULUS as R
+from . import _bump_phase
+from .field import (
+    FR,
+    NLIMBS,
+    ints_to_limbs,
+    limbs_to_u64,
+    u64_to_limbs,
+)
+
+_plan_lock = threading.Lock()
+_twiddle_plans: dict[tuple[int, int], list[np.ndarray]] = {}
+_bitrev_cache: dict[int, np.ndarray] = {}
+_ninv_cache: dict[tuple[int, bool], np.ndarray] = {}
+
+
+def _bitrev_perm(n: int) -> np.ndarray:
+    """Index vector for the DIT input permutation (cached per n)."""
+    perm = _bitrev_cache.get(n)
+    if perm is None:
+        bits = n.bit_length() - 1
+        idx = np.arange(n, dtype=np.int64)
+        rev = np.zeros(n, dtype=np.int64)
+        for b in range(bits):
+            rev |= ((idx >> b) & 1) << (bits - 1 - b)
+        perm = rev
+        _bitrev_cache[n] = perm
+    return perm
+
+
+def _twiddle_plan(n: int, root: int) -> list[np.ndarray]:
+    """Per-stage Montgomery twiddles ``w_len^k, k < L/2`` for
+    ``L = 2, 4, ..., n`` (host ints once, then cached)."""
+    key = (n, root)
+    with _plan_lock:
+        plan = _twiddle_plans.get(key)
+    if plan is not None:
+        return plan
+    plan = []
+    length = 2
+    while length <= n:
+        w_len = pow(root, n // length, R)
+        half = length >> 1
+        tws = [1] * half
+        for k in range(1, half):
+            tws[k] = tws[k - 1] * w_len % R
+        plan.append(ints_to_limbs([FR.to_mont_int(w) for w in tws]))
+        length <<= 1
+    with _plan_lock:
+        _twiddle_plans[key] = plan
+    return plan
+
+
+def _stage_fn():
+    """The jitted butterfly stage (lazy import so this module stays
+    cheap to load; jax's jit cache keys on the (blocks, L) shape)."""
+    global _STAGE
+    try:
+        return _STAGE
+    except NameError:
+        pass
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def stage(x, tw):
+        # x: (blocks, L, 16) Montgomery Fr; tw: (L//2, 16)
+        half = x.shape[1] // 2
+        u = x[:, :half]
+        t = FR.mont_mul(x[:, half:], tw[None, :, :])
+        return jnp.concatenate([FR.add(u, t), FR.sub(u, t)], axis=1)
+
+    _STAGE = stage
+    return stage
+
+
+def _scale_fn():
+    global _SCALE
+    try:
+        return _SCALE
+    except NameError:
+        pass
+    import jax
+
+    @jax.jit
+    def scale(x, c):
+        return FR.mont_mul(x, c[None, :])
+
+    _SCALE = scale
+    return scale
+
+
+def ntt_limbs(arr: np.ndarray, root: int, inverse: bool) -> np.ndarray:
+    """In-place NTT over (n, 4) u64 canonical Fr limbs — the graft
+    analog of ``native zk_ntt`` (same signature Domain.ntt_limbs uses)."""
+    t0 = time.perf_counter()
+    import jax.numpy as jnp
+
+    n = arr.shape[0]
+    if n & (n - 1):
+        raise ValueError(f"NTT size must be a power of two, got {n}")
+    if n == 1:
+        _bump_phase("ntt", time.perf_counter() - t0)
+        return arr
+
+    limbs = u64_to_limbs(arr)[_bitrev_perm(n)]
+    x = FR.to_mont(jnp.asarray(limbs))
+
+    stage = _stage_fn()
+    for tw in _twiddle_plan(n, root):
+        length = 2 * tw.shape[0]
+        x = stage(x.reshape(n // length, length, NLIMBS), jnp.asarray(tw))
+        x = x.reshape(n, NLIMBS)
+
+    if inverse:
+        key = (n, True)
+        c = _ninv_cache.get(key)
+        if c is None:
+            c = ints_to_limbs([FR.to_mont_int(pow(n, R - 2, R))])[0]
+            _ninv_cache[key] = c
+        x = _scale_fn()(x, jnp.asarray(c))
+
+    out = np.asarray(FR.from_mont(x))
+    arr[:] = limbs_to_u64(out)
+    _bump_phase("ntt", time.perf_counter() - t0)
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# Pinned kernel invariants (graftlint passes 1/8/12).  One butterfly
+# stage is a reshape + one Montgomery multiply of the odd half against
+# the broadcast twiddle vector + one lazy add/sub pair: pure lane
+# arithmetic, no gather/scatter (the bit-reverse shuffle happens once
+# on the host, outside the kernel).  Memory rows are per butterfly
+# lane (n = number of (16,)-limb elements in the stage input).
+# ---------------------------------------------------------------------------
+
+from ...analysis.budget import (  # noqa: E402  (kept next to the kernel)
+    CommBudget,
+    KernelBudget,
+    MemBudget,
+    declare,
+    declare_comm,
+    declare_mem,
+)
+
+declare(
+    KernelBudget(
+        backend="zk-graft-ntt-stage",
+        max_random_gathers=0,
+        max_scatters=0,
+        require_primitives=("dot_general",),
+        notes="radix-2 butterfly stage: twiddle mont_mul (one-hot "
+        "column matmul) + lazy add/sub; bit-reverse stays on host",
+    )
+)
+
+declare_comm(
+    CommBudget(
+        backend="zk-graft-ntt-stage",
+        notes="single-device field kernel: no wire, no host traffic",
+    )
+)
+
+declare_mem(
+    MemBudget(
+        backend="zk-graft-ntt-stage",
+        resident_n=80.0,  # stage input + twiddle slice (measured 66 B/lane)
+        resident_const=8192.0,
+        transient_n=1024.0,  # odd-half mont_mul columns + concat (920 B/lane)
+        transient_const=16384.0,
+        notes="per-stage lives: odd-half product columns, carry "
+        "sweeps, and the unaliased concat output",
+    )
+)

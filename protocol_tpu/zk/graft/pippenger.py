@@ -1,0 +1,528 @@
+"""Vectorized Pippenger MSM over BN254 G1 (PERF.md §22).
+
+Window decomposition: c=8 fixed windows — byte ``w`` of the 32-byte
+little-endian scalar is the window-``w`` digit, so digit extraction is
+a ``view(uint8)`` and all 32 windows batch through every kernel as one
+leading axis (bucket setup — point conversion + compiled kernels — is
+paid once per prove via :class:`PointCache`).
+
+Bucket accumulation rides the repo's sorted-segment machinery
+(:mod:`protocol_tpu.ops.segments`, the ``ops/sparse.py`` rowsum shape):
+per window, digits are argsorted, points gathered into digit order, and
+per-bucket sums folded with a **two-level segmented fold** — a
+block-local sequential fold (``lax.scan`` over B=64 columns) followed
+by a Hillis–Steele carry scan over the block tails.  That is O(n) group
+adds total instead of the O(n log n) of a flat scan — the same
+hierarchy ``rowsum_sorted`` uses, with the EC group as the monoid.
+Every scatter is honestly ``unique_indices``: a digit's run ends at
+exactly one lane, and non-end lanes are parked at distinct
+out-of-range-of-``[:256]`` slots.
+
+Points are Jacobian over Fq in the Montgomery domain, ``Z == 0`` is the
+point at infinity.  Addition is complete: identity lanes resolve by
+select, ``P == -Q`` collapses to ``Z3 = 0`` automatically, and the
+rare ``P == Q`` collision (a discrete-log relation between SRS sums)
+is patched by a ``lax.cond`` whose double branch only executes when a
+collision actually occurs — completeness at ~zero amortized cost.
+
+The last mile — 255 bucket-weighted sums per window and the Horner
+window combine — is O(windows · nonempty buckets) exact Python-int
+Jacobian math on the host, ending in the single modular inversion of
+the whole MSM.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ...utils.limbs import to_limbs_fast
+from ..bn254 import G1
+from ..rns import FQ_MODULUS as Q
+from ...crypto.field import MODULUS as FR_MOD
+from . import _bump_phase
+from .field import FQ, NLIMBS, is_zero, limbs_to_ints, u64_to_limbs
+
+WINDOWS = 32
+C_BITS = 8
+N_BUCKETS = 1 << C_BITS
+BLOCK = 64
+
+
+# ---------------------------------------------------------------------------
+# Traced EC group law (Jacobian over Montgomery Fq), (..., 3, 16) u32
+# ---------------------------------------------------------------------------
+
+
+def _jdbl(p):
+    """dbl-2009-l, 7 muls; Z==0 stays Z==0 (infinity is absorbing)."""
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    a = FQ.mont_mul(x, x)
+    b = FQ.mont_mul(y, y)
+    c = FQ.mont_mul(b, b)
+    t = FQ.add(x, b)
+    d = FQ.sub(FQ.sub(FQ.mont_mul(t, t), a), c)
+    d = FQ.add(d, d)
+    e = FQ.add(FQ.add(a, a), a)
+    f = FQ.mont_mul(e, e)
+    x3 = FQ.sub(f, FQ.add(d, d))
+    c8 = FQ.add(c, c)
+    c8 = FQ.add(c8, c8)
+    c8 = FQ.add(c8, c8)
+    y3 = FQ.sub(FQ.mont_mul(e, FQ.sub(d, x3)), c8)
+    z3 = FQ.mont_mul(y, z)
+    z3 = FQ.add(z3, z3)
+    import jax.numpy as jnp
+
+    return jnp.stack([x3, y3, z3], axis=-2)
+
+
+def _jadd(p, q):
+    """Complete Jacobian add (add-2007-bl shape, 16 muls).
+
+    ``P == -Q`` needs no select: ``H == 0`` forces ``Z3 = 0``.  The
+    ``P == Q`` collision is patched under ``lax.cond`` so the doubling
+    formula's 7 extra muls are only paid when a collision exists in
+    the batch (for MSM partial sums that is a discrete-log relation —
+    essentially never — but completeness is the contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    x2, y2, z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+    z1z = is_zero(z1)
+    z2z = is_zero(z2)
+    z1z1 = FQ.mont_mul(z1, z1)
+    z2z2 = FQ.mont_mul(z2, z2)
+    u1 = FQ.mont_mul(x1, z2z2)
+    u2 = FQ.mont_mul(x2, z1z1)
+    s1 = FQ.mont_mul(y1, FQ.mont_mul(z2, z2z2))
+    s2 = FQ.mont_mul(y2, FQ.mont_mul(z1, z1z1))
+    h = FQ.sub(u2, u1)
+    r = FQ.sub(s2, s1)
+    hh = FQ.mont_mul(h, h)
+    hhh = FQ.mont_mul(h, hh)
+    v = FQ.mont_mul(u1, hh)
+    r2 = FQ.mont_mul(r, r)
+    x3 = FQ.sub(FQ.sub(r2, hhh), FQ.add(v, v))
+    y3 = FQ.sub(FQ.mont_mul(r, FQ.sub(v, x3)), FQ.mont_mul(s1, hhh))
+    z3 = FQ.mont_mul(FQ.mont_mul(z1, z2), h)
+    gen = jnp.stack([x3, y3, z3], axis=-2)
+
+    need_dbl = is_zero(h) & is_zero(r) & ~z1z & ~z2z
+    gen = jax.lax.cond(
+        jnp.any(need_dbl),
+        lambda g: jnp.where(need_dbl[..., None, None], _jdbl(p), g),
+        lambda g: g,
+        gen,
+    )
+    out = jnp.where(z2z[..., None, None], p, gen)
+    return jnp.where(z1z[..., None, None], q, out)
+
+
+# ---------------------------------------------------------------------------
+# The four jitted kernels of one MSM (names match the budget registry)
+# ---------------------------------------------------------------------------
+
+
+def _kernels():
+    """Build (once) the jitted kernel table; jax loads lazily here."""
+    global _K
+    try:
+        return _K
+    except NameError:
+        pass
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.segments import run_end_mask, segmented_carry_scan
+
+    @jax.jit
+    def window(digits, points):
+        # zk-graft-msm-window: per-window digit sort + point gather.
+        order = jnp.argsort(digits, axis=-1)
+        ds = jnp.take_along_axis(digits, order, axis=-1)
+        pts = points[order]
+        return ds, pts
+
+    @jax.jit
+    def fold(ptsb, dsb):
+        # zk-graft-msm-scan (level 1): block-local sequential fold.
+        cols = jnp.moveaxis(ptsb, 2, 0)  # (B, W, nb, 3, 16)
+        sames = jnp.moveaxis(dsb[..., 1:] == dsb[..., :-1], 2, 0)
+
+        def step(run, xs):
+            col, same = xs
+            nxt = jnp.where(same[..., None, None], _jadd(run, col), col)
+            return nxt, nxt
+
+        init = cols[0]
+        tails, scans = jax.lax.scan(step, init, (cols[1:], sames))
+        local = jnp.concatenate([init[None], scans], axis=0)
+        return jnp.moveaxis(local, 0, 2), tails
+
+    @jax.jit
+    def carry(tails, flags):
+        # zk-graft-msm-scan (level 2): segmented H-S over block tails.
+        return segmented_carry_scan(tails, flags, _jadd, axis=1)
+
+    @jax.jit
+    def bucket(local, ds, dsb, c):
+        # zk-graft-msm-bucket: run-end extraction + two unique scatters.
+        w, n = ds.shape
+        blk = n // c.shape[1]
+        ends = run_end_mask(ds)
+        lane = jnp.arange(n)
+        head = jnp.repeat(dsb[:, :, 0], blk, axis=-1)
+        tail_prev = jnp.repeat(jnp.roll(dsb[:, :, -1], 1, axis=-1), blk, axis=-1)
+        in_head_run = (ds == head) & (lane // blk > 0) & (tail_prev == ds)
+        c_prev = jnp.repeat(jnp.roll(c, 1, axis=1), blk, axis=1)
+
+        rows = jnp.arange(w)[:, None]
+        park = N_BUCKETS + lane
+        idx_local = jnp.where(ends, ds, park)
+        buf = jnp.zeros((w, N_BUCKETS + n, 3, NLIMBS), jnp.uint32)
+        b_local = buf.at[rows, idx_local].set(local, unique_indices=True)
+        idx_carry = jnp.where(ends & in_head_run, ds, park)
+        b_carry = buf.at[rows, idx_carry].set(c_prev, unique_indices=True)
+        # zeros are Z == 0 == infinity, so empty buckets / parked lanes
+        # vanish in the combine.
+        out = _jadd(b_local[:, :N_BUCKETS], b_carry[:, :N_BUCKETS])
+        return FQ.from_mont(out)
+
+    _K = {"window": window, "fold": fold, "carry": carry, "bucket": bucket}
+    return _K
+
+
+# ---------------------------------------------------------------------------
+# Point preprocessing (once per prove)
+# ---------------------------------------------------------------------------
+
+
+def _points_to_u64(points) -> np.ndarray:
+    if isinstance(points, np.ndarray):
+        return np.ascontiguousarray(points, dtype=np.uint64)
+    buf = b"".join(
+        p.x.to_bytes(32, "little") + p.y.to_bytes(32, "little") for p in points
+    )
+    return np.frombuffer(buf, dtype=np.uint64).reshape(-1, 8).copy()
+
+
+class PointCache:
+    """Device-resident Montgomery-Jacobian points, padded to a power of
+    two so every MSM over a prefix of the SRS reuses the same compiled
+    shapes (sliced, never re-converted)."""
+
+    __slots__ = ("n", "padded", "points")
+
+    def __init__(self, n: int, padded: int, points):
+        self.n = n
+        self.padded = padded
+        self.points = points
+
+    @classmethod
+    def build(cls, points) -> "PointCache":
+        import jax.numpy as jnp
+
+        raw = _points_to_u64(points)
+        n = raw.shape[0]
+        if n == 0:
+            raise ValueError("empty point set")
+        padded = 1 << max(0, (n - 1).bit_length())
+        if padded > n:
+            raw = np.concatenate([raw, np.repeat(raw[:1], padded - n, axis=0)])
+        x = u64_to_limbs(raw[:, :4])
+        y = u64_to_limbs(raw[:, 4:])
+        ident = ~np.logical_or(x.any(axis=1), y.any(axis=1))
+        xm = FQ.to_mont(jnp.asarray(x))
+        ym = FQ.to_mont(jnp.asarray(y))
+        one = np.broadcast_to(FQ.r_np, (padded, NLIMBS)).copy()
+        one[ident] = 0
+        cache = jnp.stack([xm, ym, jnp.asarray(one)], axis=1)  # (n, 3, 16)
+        return cls(n, padded, cache)
+
+
+# ---------------------------------------------------------------------------
+# Host last mile: exact Python-int Jacobian bucket reduction
+# ---------------------------------------------------------------------------
+
+
+def _hdbl(p):
+    if p is None:
+        return None
+    x, y, z = p
+    a = x * x % Q
+    b = y * y % Q
+    c = b * b % Q
+    d = 2 * ((x + b) * (x + b) - a - c) % Q
+    e = 3 * a % Q
+    f = e * e % Q
+    x3 = (f - 2 * d) % Q
+    y3 = (e * (d - x3) - 8 * c) % Q
+    z3 = 2 * y * z % Q
+    return (x3, y3, z3)
+
+
+def _hadd(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    z1z1 = z1 * z1 % Q
+    z2z2 = z2 * z2 % Q
+    u1 = x1 * z2z2 % Q
+    u2 = x2 * z1z1 % Q
+    s1 = y1 * z2 * z2z2 % Q
+    s2 = y2 * z1 * z1z1 % Q
+    if u1 == u2:
+        if s1 == s2:
+            return _hdbl(p)
+        return None
+    h = (u2 - u1) % Q
+    r = (s2 - s1) % Q
+    hh = h * h % Q
+    hhh = h * hh % Q
+    v = u1 * hh % Q
+    x3 = (r * r - hhh - 2 * v) % Q
+    y3 = (r * (v - x3) - s1 * hhh) % Q
+    z3 = z1 * z2 % Q * h % Q
+    return (x3, y3, z3)
+
+
+def _hmul(p, k):
+    acc = None
+    while k:
+        if k & 1:
+            acc = _hadd(acc, p)
+        p = _hdbl(p)
+        k >>= 1
+    return acc
+
+
+def _finish(buckets: np.ndarray) -> G1:
+    """(32, 256, 3, 16) canonical Fq limb buckets -> affine G1.
+
+    Per window a descending running sum (empty-gap runs collapsed into
+    one scalar multiple) then Horner across windows; one inversion."""
+    zmask = buckets[:, :, 2, :].any(axis=-1)
+    ws, ds = np.nonzero(zmask)
+    vals = {}
+    if len(ws):
+        flat = buckets[ws, ds].reshape(len(ws), 3 * NLIMBS)
+        ints = limbs_to_ints(flat.reshape(-1, NLIMBS))
+        for i, (w, d) in enumerate(zip(ws, ds)):
+            vals[(int(w), int(d))] = tuple(ints[3 * i : 3 * i + 3])
+
+    total = None
+    for w in reversed(range(WINDOWS)):
+        if total is not None:
+            for _ in range(C_BITS):
+                total = _hdbl(total)
+        s = None
+        acc = None
+        gap = 0
+        for d in range(N_BUCKETS - 1, 0, -1):
+            b = vals.get((w, d))
+            if b is None:
+                if s is not None:
+                    gap += 1
+                continue
+            if gap:
+                acc = _hadd(acc, _hmul(s, gap))
+                gap = 0
+            s = _hadd(s, b)
+            acc = _hadd(acc, s)
+        if gap:
+            acc = _hadd(acc, _hmul(s, gap))
+        total = _hadd(total, acc)
+
+    if total is None or total[2] == 0:
+        return G1(0, 0)
+    x, y, z = total
+    zinv = pow(z, Q - 2, Q)
+    zi2 = zinv * zinv % Q
+    return G1(x * zi2 % Q, y * zi2 % Q * zinv % Q)
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def msm_limbs(scalars: np.ndarray, cache: PointCache) -> G1:
+    """MSM of (n, 4) canonical u64 scalar limbs against a point cache.
+
+    Scalars are zero-padded up to the compiled power-of-two shape —
+    digit-0 lanes never leave bucket 0, which the reduction skips, so
+    padding is free and every commit of one prove shares compilations.
+    """
+    t0 = time.perf_counter()
+    import jax.numpy as jnp
+
+    n = int(scalars.shape[0])
+    if n > cache.n:
+        raise ValueError(
+            f"msm length mismatch: {n} scalars vs {cache.n} points"
+        )
+    if n == 0:
+        return G1(0, 0)
+    m = 1 << max(0, (n - 1).bit_length())
+    arr = np.ascontiguousarray(scalars, dtype=np.uint64)
+    if m > n:
+        arr = np.concatenate([arr, np.zeros((m - n, 4), np.uint64)])
+    digits = np.ascontiguousarray(arr).view(np.uint8).reshape(m, 32).T
+    k = _kernels()
+    ds, pts = k["window"](jnp.asarray(digits.astype(np.int32)), cache.points[:m])
+    blk = min(BLOCK, m)
+    nb = m // blk
+    dsb = ds.reshape(WINDOWS, nb, blk)
+    local, tails = k["fold"](pts.reshape(WINDOWS, nb, blk, 3, NLIMBS), dsb)
+    from ...ops.segments import block_boundary_flags
+
+    c = k["carry"](tails, block_boundary_flags(dsb))
+    buckets = np.asarray(k["bucket"](local.reshape(WINDOWS, m, 3, NLIMBS), ds, dsb, c))
+    out = _finish(buckets)
+    _bump_phase("msm", time.perf_counter() - t0)
+    return out
+
+
+def msm_limbs_batch(arrs, cache: PointCache):
+    """The ~37 commit/open MSMs of one prove against one shared cache;
+    same-shape polynomials reuse every compiled kernel."""
+    return [msm_limbs(a, cache) for a in arrs]
+
+
+def msm(scalars, points) -> G1:
+    """List-of-ints MSM (the ``kzg.msm`` dispatch target)."""
+    if len(scalars) != len(points):
+        raise ValueError(
+            f"msm length mismatch: {len(scalars)} scalars vs "
+            f"{len(points)} points"
+        )
+    if not scalars:
+        return G1(0, 0)
+    cache = PointCache.build(points)
+    arr = to_limbs_fast([s % FR_MOD for s in scalars])
+    return msm_limbs(arr, cache)
+
+
+# ---------------------------------------------------------------------------
+# Pinned kernel invariants (graftlint passes 1/8/12).  Rows are per
+# point-lane (n); the window axis is a constant 32 factor folded into
+# the coefficients.
+# ---------------------------------------------------------------------------
+
+from ...analysis.budget import (  # noqa: E402  (kept next to the kernels)
+    CommBudget,
+    KernelBudget,
+    MemBudget,
+    declare,
+    declare_comm,
+    declare_mem,
+)
+
+declare(
+    KernelBudget(
+        backend="zk-graft-msm-window",
+        max_random_gathers=2,
+        max_scatters=0,
+        require_primitives=("sort",),
+        notes="digit argsort + digit/point permute gathers; the only "
+        "random gathers in the MSM",
+    )
+)
+
+declare_comm(
+    CommBudget(
+        backend="zk-graft-msm-window",
+        notes="single-device sort/permute: no wire, no host traffic",
+    )
+)
+
+declare_mem(
+    MemBudget(
+        backend="zk-graft-msm-window",
+        # Measured (buffer assignment, N=1024/2048): resident 320 B/lane
+        # (digit rows + the (n,3,16) point ladder), transient 6401
+        # B/lane — the (32, n, 3, 16) gathered point batch is the
+        # output, plus one permute staging temp.
+        resident_n=384.0,
+        resident_const=8192.0,
+        transient_n=8192.0,
+        transient_const=32768.0,
+        notes="dominated by the (32, n, 3, 16) gathered point batch",
+    )
+)
+
+declare(
+    KernelBudget(
+        backend="zk-graft-msm-scan",
+        max_random_gathers=0,
+        max_scatters=0,
+        require_primitives=("dot_general",),
+        notes="segmented fold rounds: EC adds + where-selects; rolls "
+        "lower to slices, never gathers",
+    )
+)
+
+declare_comm(
+    CommBudget(
+        backend="zk-graft-msm-scan",
+        notes="single-device group fold: no wire, no host traffic",
+    )
+)
+
+declare_mem(
+    MemBudget(
+        backend="zk-graft-msm-scan",
+        # Measured (buffer assignment, N=128/256): resident 6272 B/lane
+        # (blocked points + digits in), transient 19155 B/lane — the
+        # scan's per-step emit stack plus the carried fold state.
+        resident_n=8192.0,
+        resident_const=8192.0,
+        transient_n=24576.0,
+        transient_const=32768.0,
+        notes="lax.scan keeps the (B, 32, nb, 3, 16) emit stack live",
+    )
+)
+
+declare(
+    KernelBudget(
+        backend="zk-graft-msm-bucket",
+        max_random_gathers=0,
+        max_scatters=2,
+        notes="run-end extraction: two honestly-unique scatters (run "
+        "ends are unique per digit; other lanes park out of range)",
+    )
+)
+
+declare_comm(
+    CommBudget(
+        backend="zk-graft-msm-bucket",
+        notes="single-device scatter + one EC combine; the bucket "
+        "array is the only device->host transfer of the MSM",
+    )
+)
+
+declare_mem(
+    MemBudget(
+        backend="zk-graft-msm-bucket",
+        # Measured (buffer assignment, N=128/256): resident 6496 B/lane
+        # (local sums + digits in), transient 12288 B/lane over a
+        # ~115.6 MB constant floor — the one-hot mul_full columns of
+        # the final EC combine run at full bucket-grid lane count
+        # (32·(256+n) lanes), so XLA materializes (lanes, 32, 16) f32
+        # product planes that dwarf the (32, 256+n, 3, 16) scatter
+        # buffers themselves.
+        resident_n=8192.0,
+        resident_const=16384.0,
+        transient_n=16384.0,
+        transient_const=125829120.0,
+        notes="scatter buffers carry n parking slots past the 256 "
+        "buckets; sliced away before the combine; the const floor is "
+        "the bucket-grid EC combine's one-hot matmul temps",
+    )
+)

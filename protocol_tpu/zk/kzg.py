@@ -18,17 +18,30 @@ import hashlib
 from dataclasses import dataclass
 
 from ..crypto.field import MODULUS as R
+from . import graft as zk_graft
 from . import native as zk_native
 from .bn254 import G1, GENERATOR, IDENTITY
 from .fields import G2, G2_GENERATOR, pairing_check
 
 
 def msm(scalars: list[int], points: list[G1]) -> G1:
-    """Multi-scalar multiplication; dispatches to the C++ Pippenger
-    kernel when built, else a Python windowed (4-bit bucket) method."""
-    assert len(scalars) <= len(points)
+    """Multi-scalar multiplication; dispatches on the ``zk_backend``
+    knob — ``graft`` routes to the jit Pippenger, ``native`` to the C++
+    kernel when built, else a Python windowed (4-bit bucket) method.
+
+    Lengths must match exactly: callers that used to rely on the old
+    silent ``points[: len(scalars)]`` truncation slice the ladder
+    themselves now, so a mismatched call is always a bug upstream.
+    """
+    if len(scalars) != len(points):
+        raise ValueError(
+            f"msm length mismatch: {len(scalars)} scalars vs "
+            f"{len(points)} points"
+        )
+    if zk_graft.zk_backend() == "graft":
+        return zk_graft.msm(scalars, points)
     if zk_native.available() and len(scalars) >= 32:
-        return zk_native.msm(scalars, points[: len(scalars)])
+        return zk_native.msm(scalars, points)
     return _msm_python(scalars, points)
 
 
@@ -165,7 +178,16 @@ class Setup:
         if isinstance(coeffs, np.ndarray):
             return self.commit_limbs(coeffs)
         assert len(coeffs) <= self.n, "polynomial exceeds SRS degree"
-        return msm([c % R for c in coeffs], self.g1_powers)
+        return msm([c % R for c in coeffs], self.g1_powers[: len(coeffs)])
+
+    def _graft_cache(self):
+        """Per-SRS device point cache: the once-per-prove bucket setup
+        the graft Pippenger amortizes across every commit/open MSM."""
+        cache = getattr(self, "_graft_points", None)
+        if cache is None:
+            cache = zk_graft.point_cache(self.g1_powers)
+            object.__setattr__(self, "_graft_points", cache)
+        return cache
 
     def commit_limbs(self, arr) -> G1:
         """Zero-conversion commitment: (n,4) canonical scalar limbs
@@ -173,11 +195,25 @@ class Setup:
         from . import native as zk_native
 
         assert arr.shape[0] <= self.n, "polynomial exceeds SRS degree"
+        if zk_graft.zk_backend() == "graft":
+            return zk_graft.msm_limbs(arr, self._graft_cache())
         cache = getattr(self, "_point_limbs", None)
         if cache is None:
             cache = zk_native._points_to_limbs(self.g1_powers)
             object.__setattr__(self, "_point_limbs", cache)
         return zk_native.msm_limbs(arr, cache[: arr.shape[0]])
+
+    def commit_batch(self, arrs) -> list[G1]:
+        """Commit a batch of (n_i, 4) canonical-limb polynomials.
+
+        Under ``native`` this is exactly a loop of :meth:`commit_limbs`
+        (byte-identical transcripts, trivially); under ``graft`` the
+        batch shares one :class:`~.graft.pippenger.PointCache` and one
+        set of compiled kernel shapes, which is where the per-prove
+        bucket-setup amortization lives."""
+        if zk_graft.zk_backend() == "graft":
+            return zk_graft.msm_limbs_batch(arrs, self._graft_cache())
+        return [self.commit(a) for a in arrs]
 
     def open(self, coeffs: list[int], z: int) -> tuple[int, G1]:
         """Evaluation y = p(z) and witness commitment W = [(p - y)/(X - z)]."""

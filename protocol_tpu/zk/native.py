@@ -265,10 +265,15 @@ def _limbs_to_point(arr: np.ndarray) -> G1:
 
 
 def msm(scalars: list[int], points: list[G1]) -> G1:
+    if len(scalars) != len(points):
+        raise ValueError(
+            f"msm length mismatch: {len(scalars)} scalars vs "
+            f"{len(points)} points"
+        )
     lib = _load()
     n = len(scalars)
     s = to_limbs_fast([x % R for x in scalars])
-    p = _points_to_limbs(points[:n])
+    p = _points_to_limbs(points)
     out = np.zeros(8, dtype=np.uint64)
     lib.zk_msm(_ptr(s), _ptr(p), n, _ptr(out))
     return _limbs_to_point(out)
@@ -277,6 +282,11 @@ def msm(scalars: list[int], points: list[G1]) -> G1:
 def msm_limbs(scalars: np.ndarray, point_limbs: np.ndarray) -> G1:
     """MSM with (n,4) canonical scalar limbs and pre-converted (n,8)
     point limbs — the zero-conversion hot path for commitments."""
+    if scalars.shape[0] != point_limbs.shape[0]:
+        raise ValueError(
+            f"msm_limbs length mismatch: {scalars.shape[0]} scalars vs "
+            f"{point_limbs.shape[0]} point rows"
+        )
     lib = _load()
     n = scalars.shape[0]
     s = np.ascontiguousarray(scalars, dtype=np.uint64)

@@ -50,6 +50,7 @@ from ..obs import TRACER
 from ..utils.limbs import from_limbs_fast, ptr as _ptr, to_limbs, to_limbs_fast
 from .bn254 import G1, GENERATOR
 from .cs import Column, ConstraintSystem
+from . import graft as zk_graft
 from .kzg import Setup, _div_by_linear, _eval_poly, msm
 from .transcript import KeccakRead, KeccakWrite, PoseidonRead, PoseidonWrite
 
@@ -313,6 +314,9 @@ class Domain:
         return self._ntt(list(evals), self.omega_inv, True)
 
     def _ntt(self, vals: list[int], root: int, inverse: bool) -> list[int]:
+        if zk_graft.zk_backend() == "graft":
+            arr = zk_graft.ntt_limbs(to_limbs_fast(vals), root, inverse)
+            return from_limbs_fast(arr)
         lib = _native_lib()
         if lib is None:
             return _py_ntt(vals, root, inverse)
@@ -333,7 +337,9 @@ class Domain:
         return self.ntt_limbs(arr, self.omega_inv, True)
 
     def ntt_limbs(self, arr: np.ndarray, root: int, inverse: bool) -> np.ndarray:
-        """In-place NTT over a (n, 4) limb array (native path)."""
+        """In-place NTT over a (n, 4) limb array (``zk_backend`` path)."""
+        if zk_graft.zk_backend() == "graft":
+            return zk_graft.ntt_limbs(arr, root, inverse)
         lib = _native_lib()
         if lib is None:
             vals = _py_ntt(from_limbs_fast(arr), root, inverse)
@@ -1001,55 +1007,67 @@ class _ProveAttribution:
     Two disjoint layers, attached as closed children of the enclosing
     span (the manager's ``snark``) when the prove finishes:
 
-    - the native engine's phase-timer table (``zk.native.phase_stats``:
-      msm / ntt / gate_eval / field_ops / srs), delta'd over the whole
-      prove — the inner loops, with call counts;
+    - the kernel engines' phase-timer tables (``zk.native.phase_stats``
+      and ``zk.graft.phase_stats``: msm / ntt / gate_eval / field_ops /
+      srs), each delta'd over the whole prove — the inner loops, with
+      call counts, tagged ``engine="native"`` / ``engine="graft"`` so
+      the same ``snark -> {msm, ntt, ...}`` children survive a
+      ``zk_backend`` switch (tools/prover_pipe.py asserts this);
     - per-stage *host residuals* (``witness_gen`` / ``commit`` /
       ``quotient`` / ``open``): each stage's wall-clock minus whatever
-      native engine time ran inside it, so the stage spans and the
-      engine spans partition the prove instead of double counting.
+      engine time ran inside it, so the stage spans and the engine
+      spans partition the prove instead of double counting.
 
-    Without the native runtime the engine rows are zero and the stage
-    residuals are full stage wall-clock — attribution still sums to the
-    prove.  The table is process-global, so a concurrent native user on
-    another thread (e.g. an /aggregate verify) can inflate the engine
-    rows of an overlapping prove; attribution is diagnostic, not an
-    invariant, and the skew is bounded by that request's work.
+    Without either kernel runtime the engine rows are zero and the
+    stage residuals are full stage wall-clock — attribution still sums
+    to the prove.  The tables are process-global, so a concurrent
+    engine user on another thread (e.g. an /aggregate verify) can
+    inflate the engine rows of an overlapping prove; attribution is
+    diagnostic, not an invariant, and the skew is bounded by that
+    request's work.
     """
 
     def __init__(self) -> None:
         from . import native as zk_native
 
-        self._native = zk_native
-        self._snap0 = zk_native.phase_stats()
+        self._engines = (("native", zk_native), ("graft", zk_graft))
+        self._snap0 = {
+            name: mod.phase_stats() for name, mod in self._engines
+        }
         self._stages: dict[str, list[float]] = {}  # name -> [host_s, calls]
 
     @staticmethod
     def _total_seconds(stats: dict[str, dict[str, float]]) -> float:
         return sum(row["seconds"] for row in stats.values())
 
+    def _engine_seconds(self) -> float:
+        return sum(
+            self._total_seconds(mod.phase_stats()) for _, mod in self._engines
+        )
+
     @contextlib.contextmanager
     def stage(self, name: str):
         t0 = time.perf_counter()
-        n0 = self._total_seconds(self._native.phase_stats())
+        n0 = self._engine_seconds()
         try:
             yield
         finally:
             wall = time.perf_counter() - t0
-            native = self._total_seconds(self._native.phase_stats()) - n0
+            engine = self._engine_seconds() - n0
             rec = self._stages.setdefault(name, [0.0, 0])
-            rec[0] += max(wall - native, 0.0)
+            rec[0] += max(wall - engine, 0.0)
             rec[1] += 1
 
     def attach(self) -> None:
         """Bridge the attribution into the current span tree (no-op
         outside a span, e.g. direct prove() calls in tests)."""
-        delta = self._native.phase_delta(self._snap0, self._native.phase_stats())
-        for phase, row in delta.items():
-            if row["calls"] > 0:
-                TRACER.attach_closed(
-                    phase, row["seconds"], calls=int(row["calls"]), engine="native"
-                )
+        for name, mod in self._engines:
+            delta = mod.phase_delta(self._snap0[name], mod.phase_stats())
+            for phase, row in delta.items():
+                if row["calls"] > 0:
+                    TRACER.attach_closed(
+                        phase, row["seconds"], calls=int(row["calls"]), engine=name
+                    )
         for name, (host_s, calls) in self._stages.items():
             TRACER.attach_closed(name, host_s, calls=int(calls), engine="host")
 
@@ -1235,8 +1253,8 @@ def prove(
             for i, v in enumerate(advice_values)
         ]
     with att.stage("commit"):
-        for p in advice_polys:
-            transcript.write_point(srs.commit(p))
+        for c in srs.commit_batch(advice_polys):
+            transcript.write_point(c)
 
     # Round 1.5: lookup permutations (Halo2 ordering: theta after
     # advice, A'/S' commitments before beta/gamma).
@@ -1326,8 +1344,8 @@ def prove(
         if vk.chunks:
             assert start == 1, "permutation product != 1 (copy constraints broken?)"
     with att.stage("commit"):
-        for p in z_polys:
-            transcript.write_point(srs.commit(p))
+        for c in srs.commit_batch(z_polys):
+            transcript.write_point(c)
 
     with att.stage("witness_gen"):
         # Lookup grand products Z_i over the active rows.
@@ -1465,8 +1483,8 @@ def prove(
     t_chunks = [t_limbs[i : i + n] for i in range(0, t_limbs.shape[0], n)]
     _quotient_stage.__exit__(None, None, None)
     with att.stage("commit"):
-        for chunk in t_chunks:
-            transcript.write_point(srs.commit(np.ascontiguousarray(chunk)))
+        for c in srs.commit_batch([np.ascontiguousarray(ch) for ch in t_chunks]):
+            transcript.write_point(c)
     with att.stage("transcript"):
         x = transcript.squeeze_challenge()
 

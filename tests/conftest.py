@@ -29,6 +29,15 @@ import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
 
+# The zk.graft proving kernels are large traced programs (an EC add
+# inlines 16 Montgomery multiplies); XLA:CPU takes tens of seconds per
+# (shape, kernel) pair.  Persist compiled executables next to the
+# keygen cache so repeat test runs pay compilation once per machine.
+_jax_cache = pathlib.Path(os.environ["PROTOCOL_TPU_CACHE"]) / "jax"
+_jax_cache.mkdir(parents=True, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", str(_jax_cache))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 #: Modules run under ``jax.transfer_guard("disallow")``: the
 #: backend-equivalence suites, where an implicit host transfer means a
 #: per-call (or worse, per-iteration) sync hiding in a hot path — the

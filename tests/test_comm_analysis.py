@@ -129,11 +129,14 @@ class TestRealTree:
                 )
 
     def test_budget_table_matches_registry(self):
+        from protocol_tpu.analysis.zk_lowering import ensure_budgets
+
+        zk_names = set(ensure_budgets())
         declared = set(COMM_INVARIANTS)
         registered = {
             n for n in registered_backends() if n not in NON_JAX_BACKENDS
         }
-        assert declared == registered
+        assert declared == registered | zk_names
 
     def test_no_stale_comm_waivers(self, comm_report):
         _, section = comm_report

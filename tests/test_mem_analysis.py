@@ -163,11 +163,14 @@ class TestRealTree:
                 assert s["host_transfers"] == [], (name, s["scale"])
 
     def test_budget_table_matches_registry(self):
+        from protocol_tpu.analysis.zk_lowering import ensure_budgets
+
+        zk_names = set(ensure_budgets())
         declared = set(MEM_INVARIANTS)
         registered = {
             n for n in registered_backends() if n not in NON_JAX_BACKENDS
         }
-        assert declared == registered
+        assert declared == registered | zk_names
 
     def test_waiver_table_live_not_stale(self, mem_report):
         """The hash-memo waiver is live (the rule really fires on

@@ -616,3 +616,96 @@ class TestMemPeakSeries:
         parity the ISSUE names."""
         report = {"tool": "mem_probe", "ok": True, "entries": []}
         assert not perf_sentinel._is_non_bench_artifact(report)
+
+
+class TestZkKernelSeries:
+    def test_msm_rounds_feed_the_gate(self, tmp_path):
+        """ISSUE 20: MSM_r*.json is in the default globs, its
+        ``entries`` list is walked, and msm_points_per_s /
+        ntt_butterflies_per_s gate downward while prove_seconds gates
+        upward — per backend/size, since the metric string carries
+        both."""
+        rounds = [
+            (50_000.0, 2_000_000.0, 8.0),
+            (20_000.0, 800_000.0, 14.0),  # all three regressed
+        ]
+        for i, (msm, ntt, prove) in enumerate(rounds, start=1):
+            (tmp_path / f"MSM_r{i:02d}.json").write_text(
+                json.dumps(
+                    {
+                        "n": i,
+                        "entries": [
+                            {
+                                "metric": "zk msm throughput (native, n=2^14, bn254 G1)",
+                                "msm_points_per_s": msm,
+                                "unit": "points/s",
+                            },
+                            {
+                                "metric": "zk ntt throughput (native, n=2^14, fr)",
+                                "ntt_butterflies_per_s": ntt,
+                                "unit": "butterflies/s",
+                            },
+                            {
+                                "metric": "plonk epoch prove wall (native, 5 peers)",
+                                "prove_seconds": prove,
+                                "unit": "seconds",
+                            },
+                        ],
+                    }
+                )
+            )
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(tmp_path), "--out", str(out)])
+        assert rc == 1
+        report = json.loads(out.read_text())
+        assert {
+            "zk msm throughput (native, n=2^14, bn254 G1) :: msm_points_per_s",
+            "zk ntt throughput (native, n=2^14, fr) :: ntt_butterflies_per_s",
+            "plonk epoch prove wall (native, 5 peers) :: prove_seconds",
+        } <= set(report["regressions"])
+
+    def test_backend_series_never_cross_compare(self, tmp_path):
+        """A slow graft round beside a fast native round is two
+        different series (the backend is in the metric string), so
+        neither regresses the other."""
+        (tmp_path / "MSM_r01.json").write_text(
+            json.dumps(
+                {
+                    "n": 1,
+                    "entries": [
+                        {
+                            "metric": "zk msm throughput (native, n=2^10, bn254 G1)",
+                            "msm_points_per_s": 100_000.0,
+                            "unit": "points/s",
+                        }
+                    ],
+                }
+            )
+        )
+        (tmp_path / "MSM_r02.json").write_text(
+            json.dumps(
+                {
+                    "n": 2,
+                    "entries": [
+                        {
+                            "metric": "zk msm throughput (graft, n=2^10, bn254 G1)",
+                            "msm_points_per_s": 150.0,
+                            "unit": "points/s",
+                        }
+                    ],
+                }
+            )
+        )
+        rc = perf_sentinel.main(
+            ["--history", str(tmp_path), "--out", str(tmp_path / "S.json")]
+        )
+        assert rc == 0
+
+    def test_committed_msm_round_feeds_the_gate(self, tmp_path):
+        out = tmp_path / "SENTINEL.json"
+        rc = perf_sentinel.main(["--history", str(REPO), "--out", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert any("MSM_r01.json" in f for f in report["history_files"])
+        assert any("msm_points_per_s" in k for k in report["series"])
+        assert any("ntt_butterflies_per_s" in k for k in report["series"])

@@ -1,0 +1,354 @@
+"""zk.graft parity suite (the jit MSM/NTT proving backend).
+
+The acceptance oracle for the graft backend is bit-for-bit parity:
+every kernel result must equal the native/python engines exactly —
+same canonical field bytes, same curve points, same proof bytes — so
+the ``zk_backend`` knob is pure execution selection.  This file pins
+that oracle across the edge cases (zero scalars, identity points,
+n=1, non-power-of-two batches padded up, max-field-element scalars,
+NTT round-trips), the dispatch ladder's length-mismatch regression,
+the attribution bridge, and the analyzer's zk coverage.
+
+Compile discipline: the non-slow tests reuse a small set of kernel
+shapes (the persistent compilation cache in conftest.py makes repeat
+runs cheap); the wide sweeps and the compile-heavy analyzer legs are
+``slow``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import pytest
+
+from protocol_tpu.crypto.field import MODULUS as R
+from protocol_tpu.utils.limbs import to_limbs_fast
+from protocol_tpu.zk import graft as zk_graft
+from protocol_tpu.zk import kzg, plonk
+from protocol_tpu.zk import native as zk_native
+from protocol_tpu.zk.bn254 import G1, GENERATOR, IDENTITY
+from protocol_tpu.zk.graft import use_zk_backend
+
+RNG = np.random.default_rng(20)
+
+
+def _rand_scalar(rng) -> int:
+    return int.from_bytes(rng.bytes(32), "little") % R
+
+
+def _rand_points(rng, n: int) -> list[G1]:
+    return [GENERATOR.mul(_rand_scalar(rng) or 1) for _ in range(n)]
+
+
+def _ref_msm(scalars: list[int], points: list[G1]) -> G1:
+    """Exact affine reference: sum of per-term double-and-add."""
+    return functools.reduce(
+        G1.add, (p.mul(s % R) for s, p in zip(scalars, points)), IDENTITY
+    )
+
+
+class TestBackendKnob:
+    def test_default_is_native(self):
+        assert zk_graft.zk_backend() == "native"
+
+    def test_context_flips_and_restores(self):
+        with use_zk_backend("graft"):
+            assert zk_graft.zk_backend() == "graft"
+            with use_zk_backend("native"):
+                assert zk_graft.zk_backend() == "native"
+            assert zk_graft.zk_backend() == "graft"
+        assert zk_graft.zk_backend() == "native"
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError):
+            with use_zk_backend("cuda"):
+                pass
+
+
+class TestFieldParity:
+    @pytest.mark.parametrize("which", ["fr", "fq"])
+    def test_mulmod_matches_python(self, which):
+        from protocol_tpu.zk.graft import field as gf
+
+        F = gf.FR if which == "fr" else gf.FQ
+        mulmod = gf.mulmod_fr if which == "fr" else gf.mulmod_fq
+        rng = np.random.default_rng(7)
+        edge = [0, 1, 2, F.p - 1, F.p - 2, (1 << 255) % F.p]
+        avals = edge + [int.from_bytes(rng.bytes(32), "little") % F.p
+                        for _ in range(10)]
+        bvals = list(reversed(avals))
+        am = gf.ints_to_limbs([F.to_mont_int(a) for a in avals])
+        bm = gf.ints_to_limbs([F.to_mont_int(b) for b in bvals])
+        got = gf.limbs_to_ints(np.asarray(mulmod(am, bm)))
+        expected = [F.to_mont_int(a * b % F.p)
+                    for a, b in zip(avals, bvals)]
+        assert got == expected
+
+
+class TestNTTParity:
+    K = 8  # 256-point domain: every stage shape compiles in seconds
+
+    def test_fft_matches_native_and_roundtrips(self):
+        d = plonk.Domain(self.K)
+        rng = np.random.default_rng(11)
+        vals = [int.from_bytes(rng.bytes(32), "little") % R
+                for _ in range(d.n)]
+        vals[0] = 0
+        vals[1] = R - 1
+        reference = d.fft(list(vals))  # native lib or python fallback
+        with use_zk_backend("graft"):
+            assert d.fft(list(vals)) == reference
+            assert d.ifft(reference) == vals  # inverse(NTT(x)) == x
+
+    def test_ntt_limbs_dispatch_parity(self):
+        d = plonk.Domain(self.K)
+        rng = np.random.default_rng(13)
+        vals = [int.from_bytes(rng.bytes(32), "little") % R
+                for _ in range(d.n)]
+        native_out = d.ntt_limbs(to_limbs_fast(vals), d.omega, False)
+        with use_zk_backend("graft"):
+            graft_out = d.ntt_limbs(to_limbs_fast(vals), d.omega, False)
+        assert np.array_equal(native_out, graft_out)
+
+    def test_non_power_of_two_rejected(self):
+        arr = to_limbs_fast([1, 2, 3])
+        with pytest.raises(ValueError):
+            zk_graft.ntt_limbs(arr, plonk.Domain(2).omega, False)
+
+
+class TestMSMParity:
+    def test_edge_case_batch_pads_to_pow2(self):
+        """n=33 (padded to 64) with a zero scalar, a max-field-element
+        scalar, an identity point, and a duplicated point — all through
+        the public kzg dispatch."""
+        rng = np.random.default_rng(17)
+        n = 33
+        scalars = [_rand_scalar(rng) for _ in range(n)]
+        points = _rand_points(rng, n)
+        scalars[0] = 0
+        scalars[1] = R - 1
+        points[2] = IDENTITY
+        points[4] = points[3]
+        reference = _ref_msm(scalars, points)
+        with use_zk_backend("graft"):
+            assert kzg.msm(scalars, points) == reference
+        assert kzg.msm(scalars, points) == reference  # native/python leg
+
+    def test_single_term_and_zero_scalars(self):
+        rng = np.random.default_rng(19)
+        p = _rand_points(rng, 1)[0]
+        s = _rand_scalar(rng)
+        with use_zk_backend("graft"):
+            assert kzg.msm([s], [p]) == p.mul(s)
+            assert kzg.msm([0], [p]) == IDENTITY
+            assert kzg.msm([], []) == IDENTITY
+
+    def test_duplicate_points_hit_add_collision(self):
+        """Equal points in one bucket exercise the P==Q doubling patch
+        inside the complete Jacobian add."""
+        rng = np.random.default_rng(23)
+        p = _rand_points(rng, 1)[0]
+        with use_zk_backend("graft"):
+            assert kzg.msm([3, 3], [p, p]) == p.mul(6)
+
+
+class TestLengthMismatch:
+    """Regression: ``msm`` used to silently truncate
+    ``points[: len(scalars)]`` — now every layer raises."""
+
+    def test_kzg_msm_raises(self):
+        pts = _rand_points(np.random.default_rng(3), 3)
+        with pytest.raises(ValueError, match="length mismatch"):
+            kzg.msm([1, 2], pts)
+
+    def test_graft_msm_raises(self):
+        pts = _rand_points(np.random.default_rng(4), 2)
+        with pytest.raises(ValueError, match="length mismatch"):
+            zk_graft.msm([1, 2, 3], pts)
+
+    def test_native_msm_raises(self):
+        pts = _rand_points(np.random.default_rng(5), 2)
+        with pytest.raises(ValueError, match="length mismatch"):
+            zk_native.msm([1, 2, 3], pts)
+
+    def test_native_msm_limbs_raises(self):
+        scalars = np.zeros((2, 4), dtype=np.uint64)
+        point_limbs = np.zeros((3, 8), dtype=np.uint64)
+        with pytest.raises(ValueError, match="length mismatch"):
+            zk_native.msm_limbs(scalars, point_limbs)
+
+
+class TestCommitBatch:
+    def test_batch_matches_serial_commits(self):
+        srs = kzg.Setup.generate(4, seed=b"graft-test-srs")
+        rng = np.random.default_rng(29)
+        polys = [
+            np.asarray(
+                to_limbs_fast(
+                    [int.from_bytes(rng.bytes(32), "little") % R
+                     for _ in range(ln)]
+                )
+            )
+            for ln in (4, 7, 16)
+        ]
+        serial = [srs.commit_limbs(p) for p in polys]
+        assert srs.commit_batch(polys) == serial
+
+
+class TestAttribution:
+    def test_graft_phase_table_counts_ntt(self):
+        zk_graft.reset_phase_stats()
+        d = plonk.Domain(6)
+        with use_zk_backend("graft"):
+            d.fft([1] * d.n)
+        stats = zk_graft.phase_stats()
+        assert stats["ntt"]["calls"] >= 1
+        assert stats["ntt"]["seconds"] > 0
+
+    def test_attribution_bridges_graft_engine_rows(self):
+        """The dual-engine _ProveAttribution attaches graft phase rows
+        as engine-tagged children of the enclosing span — the same
+        ``snark -> {msm, ntt}`` shape the native timers feed."""
+        from protocol_tpu.obs import TRACER
+
+        zk_graft.reset_phase_stats()
+        with TRACER.span("snark") as sp:
+            att = plonk._ProveAttribution()
+            d = plonk.Domain(6)
+            with att.stage("quotient"), use_zk_backend("graft"):
+                d.fft([2] * d.n)
+            att.attach()
+        children = {
+            (c.name, c.attrs.get("engine")) for c in sp.children
+        }
+        assert ("ntt", "graft") in children, children
+        assert ("quotient", "host") in children, children
+
+
+class TestAnalyzerCoverage:
+    def test_zk_registry_and_budget_tables_agree(self):
+        from protocol_tpu.analysis import (
+            COMM_INVARIANTS,
+            KERNEL_INVARIANTS,
+            MEM_INVARIANTS,
+        )
+        from protocol_tpu.analysis.zk_lowering import (
+            ensure_budgets,
+            zk_kernel_names,
+        )
+
+        names = set(ensure_budgets())
+        assert names == set(zk_graft.registered_zk_kernels())
+        assert names == set(zk_kernel_names())
+        assert names <= set(KERNEL_INVARIANTS)
+        assert names <= set(COMM_INVARIANTS)
+        assert names <= set(MEM_INVARIANTS)
+
+    def test_zk_jaxpr_pass_clean(self):
+        """Pass 1 over the zk kernels (trace-only — no compile):
+        every kernel checked, zero findings."""
+        from protocol_tpu.analysis.invariants import run_jaxpr_pass
+        from protocol_tpu.analysis.zk_lowering import register
+
+        names = register()
+        findings, meta = run_jaxpr_pass(backends=names)
+        assert [f.render() for f in findings] == []
+        for name in names:
+            assert meta[name]["status"] == "checked", meta[name]
+
+
+@pytest.mark.slow
+class TestSlowParitySweep:
+    def test_msm_sweep_matches_reference(self):
+        rng = np.random.default_rng(31)
+        for n in (2, 3, 7, 16, 100):
+            scalars = [_rand_scalar(rng) for _ in range(n)]
+            points = _rand_points(rng, n)
+            if n >= 3:
+                scalars[0] = 0
+                scalars[1] = R - 1
+                points[2] = IDENTITY
+            reference = _ref_msm(scalars, points)
+            with use_zk_backend("graft"):
+                assert zk_graft.msm(scalars, points) == reference, n
+
+    def test_zk_compile_passes_clean(self):
+        """Passes 8/12/13 over the zk kernels (the ``graftlint --zk``
+        leg): real compiles at two scales, buffer-assignment memory
+        checks, and the double-compile drift wall — zero findings."""
+        from protocol_tpu.analysis.comm.checker import run_comm_pass
+        from protocol_tpu.analysis.determinism.checker import (
+            run_determinism_pass,
+        )
+        from protocol_tpu.analysis.memory.checker import run_memory_pass
+        from protocol_tpu.analysis.zk_lowering import register
+
+        names = register()
+        for runner in (run_comm_pass, run_memory_pass, run_determinism_pass):
+            findings, section = runner(backends=names)
+            assert [f.render() for f in findings] == [], runner.__name__
+            for name in names:
+                status = section["backends"][name]["status"]
+                assert status == "checked", (runner.__name__, name, status)
+
+
+@pytest.mark.slow
+class TestProveByteParity:
+    """A full PLONK prove under ``zk_backend='graft'`` must verify and
+    match the native proof byte-for-byte (statement-seeded blinding
+    keeps both paths on the same transcript)."""
+
+    @staticmethod
+    def _manager(n: int, zk_backend: str):
+        from protocol_tpu.node.bootstrap import FIXED_SET
+        from protocol_tpu.node.manager import Manager, ManagerConfig
+
+        mgr = Manager(
+            ManagerConfig(
+                prover="plonk",
+                num_neighbours=n,
+                num_iter=1,
+                fixed_set=list(FIXED_SET[:n]),
+                zk_backend=zk_backend,
+            )
+        )
+        mgr.generate_initial_attestations()
+        return mgr
+
+    def _prove_pair(self, n: int):
+        from protocol_tpu.node.epoch import Epoch
+        from protocol_tpu.prover import prove_job
+
+        native_job = self._manager(n, "native").build_proof_job(Epoch(1))
+        graft_job = self._manager(n, "graft").build_proof_job(Epoch(1))
+        assert native_job.zk_backend == "native"
+        assert graft_job.zk_backend == "graft"
+        # The knob is execution selection only: identical statements.
+        from protocol_tpu.prover import job_seed
+
+        assert job_seed(native_job) == job_seed(graft_job)
+        return prove_job(native_job), prove_job(graft_job)
+
+    def test_full_k14_statement_proof_bytes_identical(self):
+        """The acceptance statement: the full 5-peer (k=14 circuit)
+        epoch prove, both backends, byte-compared."""
+        native, graft = self._prove_pair(5)
+        assert native.pub_ins == graft.pub_ins
+        assert native.proof == graft.proof
+
+    def test_small_statement_proof_bytes_identical(self):
+        native, graft = self._prove_pair(2)
+        assert native.pub_ins == graft.pub_ins
+        assert native.proof == graft.proof
+        # Attribution survives the backend switch: the graft prove's
+        # snark span carries graft-engine msm/ntt children.
+        snark = next(
+            c for c in graft.spans["children"] if c["name"] == "snark"
+        )
+        rows = {
+            (c["name"], c.get("attrs", {}).get("engine"))
+            for c in snark["children"]
+        }
+        assert ("msm", "graft") in rows, rows
+        assert ("ntt", "graft") in rows, rows

@@ -8,8 +8,9 @@ The repo has recorded every bench round since PR 1 (``BENCH_r*.json``,
 ``OBS_r*.json``, since ISSUE 14 the crash-matrix rounds
 ``CHAOS_r*.json``, since ISSUE 15 the memory-probe rounds
 ``MEM_r*.json``, since ISSUE 16 the pod scale-out rounds
-``POD_r*.json``, and since ISSUE 18 the divergence-probe rounds
-``DET_r*.json``) but nothing ever *read* the series — a PR could
+``POD_r*.json``, since ISSUE 18 the divergence-probe rounds
+``DET_r*.json``, and since ISSUE 20 the zk kernel rounds
+``MSM_r*.json``) but nothing ever *read* the series — a PR could
 halve headline throughput and no gate would notice.  This tool closes
 the loop: it parses the recorded rounds into per-metric series
 (headline convergence seconds, cold/steady-state epoch seconds, plan
@@ -99,6 +100,14 @@ _FIELDS = {
     # serial full-graph build.
     "plan_build_seconds": True,
     "plan_build_speedup": False,
+    # ZK kernel rounds (MSM_r*.json, ISSUE 20): Pippenger MSM and NTT
+    # throughput per zk_backend/size (the proving plane's inner loops)
+    # and the full epoch prove wall — the metric string carries the
+    # backend, so a graft-lowering regression and a native-runtime
+    # regression are separate series.
+    "msm_points_per_s": False,
+    "ntt_butterflies_per_s": False,
+    "prove_seconds": True,
 }
 
 
@@ -292,7 +301,7 @@ def main(argv: list[str] | None = None) -> int:
         help="history filename glob(s); default: BENCH_r*.json, "
         "LADDER_r*.json, INGEST_r*.json, MULTICHIP_r*.json, "
         "PROVER_r*.json, OBS_r*.json, CHAOS_r*.json, MEM_r*.json, "
-        "and POD_r*.json",
+        "POD_r*.json, DET_r*.json, and MSM_r*.json",
     )
     ap.add_argument(
         "--fresh",
@@ -322,6 +331,7 @@ def main(argv: list[str] | None = None) -> int:
         "MEM_r*.json",
         "POD_r*.json",
         "DET_r*.json",
+        "MSM_r*.json",
     ]
     paths = [
         Path(p) for pat in patterns for p in globlib.glob(str(root / pat))

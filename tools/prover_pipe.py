@@ -37,7 +37,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np
 
 
-def _make_manager(graph, prover: str):
+def _make_manager(graph, prover: str, zk_backend: str = "native"):
     from protocol_tpu.node.manager import Manager, ManagerConfig
     from protocol_tpu.trust.graph import TrustGraph
 
@@ -52,6 +52,7 @@ def _make_manager(graph, prover: str):
                 ManagerConfig(
                     backend="tpu-windowed",
                     prover=prover,
+                    zk_backend=zk_backend,
                     plan_delta_max_churn=0.25,
                 )
             )
@@ -118,6 +119,14 @@ def main(argv: list[str] | None = None) -> int:
         "dispatcher thread)",
     )
     ap.add_argument(
+        "--zk-backend",
+        default="native",
+        choices=("native", "graft"),
+        help="proving-kernel backend for the enqueued jobs (zk.graft "
+        "knob); proofs are byte-identical either way, and the snark "
+        "span must carry msm/ntt attribution regardless",
+    )
+    ap.add_argument(
         "--max-overlap-ratio",
         type=float,
         default=0.7,
@@ -133,7 +142,9 @@ def main(argv: list[str] | None = None) -> int:
     from protocol_tpu.obs.metrics import PROOF_LAG_EPOCHS
     from protocol_tpu.prover import ProvingPlane, ProvingPlaneConfig
 
-    manager = _make_manager(scale_free(args.peers, args.edges, seed=7), args.prover)
+    manager = _make_manager(
+        scale_free(args.peers, args.edges, seed=7), args.prover, args.zk_backend
+    )
     manager.generate_initial_attestations()
     print(f"prover_pipe: warming {args.prover} prover (keygen/key cache)...")
     manager.warm_prover()
@@ -227,6 +238,26 @@ def main(argv: list[str] | None = None) -> int:
         prove_span = next(c for c in trace["children"] if c["name"] == "prove")
         child_names = [c["name"] for c in prove_span["children"]]
         assert "snark" in child_names, child_names
+        if args.prover == "plonk":
+            # The deep attribution must survive the zk_backend switch:
+            # whichever kernel engine ran (native timers or the graft
+            # phase table), the same msm/ntt children hang off snark,
+            # tagged with the engine that produced them.
+            snark = next(c for c in prove_span["children"] if c["name"] == "snark")
+            phases = {
+                c["name"]: c.get("attrs", {}).get("engine")
+                for c in snark["children"]
+            }
+            for phase in ("msm", "ntt"):
+                assert phase in phases, (
+                    f"epoch {k}: snark span lost {phase} attribution "
+                    f"under zk_backend={args.zk_backend} ({sorted(phases)})"
+                )
+            engines = {e for e in phases.values() if e != "host"}
+            assert args.zk_backend in engines, (
+                f"epoch {k}: no {args.zk_backend}-engine rows on the "
+                f"snark span ({phases})"
+            )
         grafted += 1
     assert grafted >= 1, "no epoch trace carries the grafted prove tree"
 
@@ -237,6 +268,7 @@ def main(argv: list[str] | None = None) -> int:
         "epochs": args.epochs,
         "prover": args.prover,
         "workers": args.workers,
+        "zk_backend": args.zk_backend,
         "median_tick_seconds": round(med_tick, 4),
         "median_prove_seconds": round(med_prove, 4),
         "sync_epoch_estimate_seconds": round(med_tick + med_prove, 4),
